@@ -1,0 +1,24 @@
+"""cess-tpu: a TPU-native decentralized-storage framework.
+
+A brand-new framework with the capability set of the reference CESS
+chain (see SURVEY.md): purchased storage space, 16 MiB segments
+erasure-coded into fragments dispatched to storage miners, a PoDR2
+random-challenge audit loop with rewards/slashing, a repair market,
+credit-weighted validator election, and TEE-attested verification —
+with the two computational hot paths (Reed-Solomon erasure coding and
+PoDR2 tag/proof computation) executed as batched GF(2^8) / prime-field
+matmuls on TPU via JAX/XLA/Pallas.
+
+Layout:
+- ``cess_tpu.ops``       device-layer kernels (GF(2^8) RS codec, PoDR2)
+- ``cess_tpu.parallel``  mesh/sharding for multi-chip scale-out
+- ``cess_tpu.models``    end-to-end pipelines (the "flagship model" =
+                         storage pipeline: segment -> encode -> tag)
+- ``cess_tpu.chain``     deterministic protocol state machine (pallet
+                         equivalents: file-bank, audit, sminer, ...)
+- ``cess_tpu.node``      consensus (RRSC-style VRF), scheduler, RPC
+- ``cess_tpu.crypto``    host-side crypto (SHA-256, RSA, VRF)
+- ``cess_tpu.native``    C++ native components (CPU codec baseline)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Device-layer ops: GF(2^8) RS codec and PoDR2 audit kernels."""

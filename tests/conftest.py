@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Tests never require TPU hardware; multi-chip sharding is exercised on a
+virtual 8-device CPU mesh (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,96 @@
+"""GF(2^8) field-core tests: table identities, matrix algebra, bit-matrix lowering."""
+import numpy as np
+import pytest
+
+from cess_tpu.ops import gf
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    xs = rng.integers(1, 256, size=64)
+    ys = rng.integers(1, 256, size=64)
+    zs = rng.integers(1, 256, size=64)
+    for a, b, c in zip(xs, ys, zs):
+        a, b, c = int(a), int(b), int(c)
+        assert gf.gf_mul(a, b) == gf.gf_mul(b, a)
+        assert gf.gf_mul(a, gf.gf_mul(b, c)) == gf.gf_mul(gf.gf_mul(a, b), c)
+        # distributivity over XOR (field addition)
+        assert gf.gf_mul(a, b ^ c) == gf.gf_mul(a, b) ^ gf.gf_mul(a, c)
+        assert gf.gf_mul(a, gf.gf_inv(a)) == 1
+
+
+def test_mul_table_matches_scalar():
+    mt = gf.mul_table()
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        a, b = int(rng.integers(0, 256)), int(rng.integers(0, 256))
+        assert mt[a, b] == gf.gf_mul(a, b)
+
+
+def test_exhaustive_inverse():
+    for a in range(1, 256):
+        assert gf.gf_mul(a, gf.gf_inv(a)) == 1
+
+
+def test_matrix_inverse_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 4, 7):
+        # random invertible matrix: perturb identity by random row ops
+        while True:
+            m = rng.integers(0, 256, size=(n, n)).astype(np.uint8)
+            try:
+                inv = gf.gf_mat_inv(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        prod = gf.gf_matmul(m, inv)
+        assert np.array_equal(prod, np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    m = np.zeros((3, 3), dtype=np.uint8)
+    with pytest.raises(np.linalg.LinAlgError):
+        gf.gf_mat_inv(m)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 8), (10, 4)])
+def test_cauchy_mds_property(k, m):
+    """Every k x k submatrix of the systematic generator is invertible."""
+    import itertools
+
+    g = gf.systematic_generator(k, m)
+    count = 0
+    for rows in itertools.combinations(range(k + m), k):
+        sub = g[list(rows)]
+        gf.gf_mat_inv(sub)  # raises if singular
+        count += 1
+        if count >= 60:  # cap the combinatorial sweep
+            break
+
+
+def test_bitmatrix_single_constant():
+    """Multiply-by-c as an 8x8 GF(2) matrix matches table multiply for all x."""
+    rng = np.random.default_rng(3)
+    for c in [0, 1, 2, 0x1D, 0xFF] + [int(v) for v in rng.integers(0, 256, 8)]:
+        m = gf._single_bitmatrix(c)
+        for x in range(256):
+            xbits = np.array([(x >> b) & 1 for b in range(8)], dtype=np.uint8)
+            ybits = (m @ xbits) & 1
+            y = int((ybits << np.arange(8)).sum())
+            assert y == gf.gf_mul(c, x), (c, x)
+
+
+def test_expanded_bitmatrix_matmul():
+    """(8r x 8k) bit-matrix applied to bit-planes == GF byte matmul."""
+    rng = np.random.default_rng(4)
+    r, k, n = 3, 4, 17
+    mat = rng.integers(0, 256, size=(r, k)).astype(np.uint8)
+    data = rng.integers(0, 256, size=(k, n)).astype(np.uint8)
+    want = gf.gf_matmul(mat, data)
+
+    mbits = gf.expand_bitmatrix(mat)  # [8r, 8k]
+    # unpack data into bit rows [8k, n]: row 8j+b = bit b of data[j]
+    dbits = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(8 * k, n)
+    obits = (mbits.astype(np.int32) @ dbits.astype(np.int32)) & 1  # [8r, n]
+    got = (obits.reshape(r, 8, n) << np.arange(8)[None, :, None]).sum(axis=1).astype(np.uint8)
+    assert np.array_equal(got, want)
