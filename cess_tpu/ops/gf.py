@@ -74,6 +74,7 @@ def mul_table() -> np.ndarray:
     t = EXP[(la + lb) % ORDER].astype(np.uint8)
     t[0, :] = 0
     t[:, 0] = 0
+    t.flags.writeable = False  # shared cached table; mutation would corrupt all math
     return t
 
 
@@ -172,6 +173,7 @@ def _single_bitmatrix(c: int) -> np.ndarray:
         prod = gf_mul(c, 1 << b)
         for a in range(8):
             m[a, b] = (prod >> a) & 1
+    m.flags.writeable = False  # shared cached matrix
     return m
 
 

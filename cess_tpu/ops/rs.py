@@ -1,0 +1,253 @@
+"""TPU-native GF(2^8) Reed-Solomon erasure codec (JAX/XLA).
+
+The reference framework's segment->fragment erasure coding runs as a
+sequential CPU loop in off-chain components (SURVEY.md §2.3, §6); here
+it becomes a batched GF(2^8) matrix apply on TPU. Two lowerings, both
+byte-exact against the NumPy oracle (cess_tpu/ops/rs_ref.py):
+
+- ``gather``: the classic SIMD "split table" scheme (two 16-entry
+  nibble tables per generator coefficient) vectorised over the byte
+  axis — VPU-bound, no bit expansion, minimal HBM traffic.
+- ``bitmatrix``: every GF(2^8) constant multiply is an 8x8 GF(2)
+  matrix, so the whole (r x q) GF apply becomes one (8r x 8q) 0/1
+  matrix applied to bit-planes with XOR accumulation = bf16 matmul on
+  the MXU followed by ``& 1``. 8x bit expansion, but all FLOPs land on
+  the systolic array. (A Pallas-fused variant that keeps the expansion
+  in VMEM lives in cess_tpu/ops/rs_pallas.py.)
+
+Geometry (k, m) is first-class (reference pins FRAGMENT_COUNT=3 i.e.
+RS(2,1), /root/reference/runtime/src/lib.rs:1026-1027; BASELINE.json
+targets RS(4,8)). Decode/repair matrices for a given erasure pattern
+are built host-side (tiny Gauss-Jordan) and applied with the same
+batched device kernels.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf
+
+Strategy = str  # "gather" | "bitmatrix" | "pallas" (fused bitmatrix, TPU default)
+
+# ---------------------------------------------------------------------------
+# Table construction (host side, tiny)
+# ---------------------------------------------------------------------------
+
+
+def nibble_tables(mat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split tables for an (r x q) GF matrix.
+
+    Returns (lo, hi), each [r, q, 16] uint8 with
+    ``lo[i, j, x] = mat[i,j] * x`` and ``hi[i, j, x] = mat[i,j] * (x << 4)``
+    so ``mat[i,j] * b == lo[i,j,b & 15] ^ hi[i,j,b >> 4]``.
+    """
+    mat = np.asarray(mat, dtype=np.uint8)
+    r, q = mat.shape
+    mt = gf.mul_table()
+    lo = np.zeros((r, q, 16), dtype=np.uint8)
+    hi = np.zeros((r, q, 16), dtype=np.uint8)
+    nib = np.arange(16, dtype=np.uint8)
+    for i in range(r):
+        for j in range(q):
+            lo[i, j] = mt[mat[i, j]][nib]
+            hi[i, j] = mt[mat[i, j]][nib << 4]
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (generic GF matrix apply, jitted per shape signature)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _apply_gather(lo: jax.Array, hi: jax.Array, data: jax.Array) -> jax.Array:
+    """GF apply via nibble-table gathers.
+
+    lo/hi: [r, q, 16] uint8 split tables; data: [..., q, n] uint8.
+    Returns [..., r, n] uint8.
+    """
+    r, q, _ = lo.shape
+    d_lo = (data & 0x0F).astype(jnp.int32)
+    d_hi = (data >> 4).astype(jnp.int32)
+    acc = None
+    for j in range(q):
+        # tables for input row j: [r, 16]; gather over the byte axis
+        t_lo = jnp.take(lo[:, j], d_lo[..., j, :], axis=1)  # [r, ..., n]
+        t_hi = jnp.take(hi[:, j], d_hi[..., j, :], axis=1)
+        term = t_lo ^ t_hi
+        acc = term if acc is None else acc ^ term
+    return jnp.moveaxis(acc, 0, -2)  # [..., r, n]
+
+
+@jax.jit
+def _apply_bitmatrix(bmat: jax.Array, data: jax.Array) -> jax.Array:
+    """GF apply via the GF(2) bit-matrix lowering on the MXU.
+
+    bmat: [8r, 8q] bf16 0/1 matrix (gf.expand_bitmatrix of the GF matrix);
+    data: [..., q, n] uint8. Returns [..., r, n] uint8.
+    """
+    q = data.shape[-2]
+    n = data.shape[-1]
+    r8 = bmat.shape[0]
+    # unpack bytes to bit-planes: [..., q, n] -> [..., 8q, n]
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (data[..., :, None, :] >> shifts[None, :, None]) & 1  # [..., q, 8, n]
+    bits = bits.reshape(*data.shape[:-2], 8 * q, n)
+    # bit-matrix apply with f32 accumulation; entries <= 8q so exact
+    prod = jnp.einsum(
+        "ab,...bn->...an",
+        bmat,
+        bits.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    obits = prod.astype(jnp.int32) & 1  # XOR accumulate == parity of the sum
+    # pack bit-planes back to bytes: [..., 8r, n] -> [..., r, n]
+    obits = obits.reshape(*data.shape[:-2], r8 // 8, 8, n)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, :, None]
+    out = jnp.sum(obits * weights, axis=-2, dtype=jnp.int32)
+    return out.astype(jnp.uint8)
+
+
+def _pallas_apply(bmat_np: np.ndarray, data: jax.Array) -> jax.Array:
+    from . import rs_pallas  # local import: pallas only needed on this path
+
+    return rs_pallas.apply_bitmatrix(bmat_np, data)
+
+
+# ---------------------------------------------------------------------------
+# Codec front-end
+# ---------------------------------------------------------------------------
+
+
+class _MatrixApply:
+    """A GF matrix baked into device tables, applied with a chosen strategy."""
+
+    def __init__(self, mat: np.ndarray, strategy: Strategy):
+        self.mat = np.asarray(mat, dtype=np.uint8)
+        self.strategy = strategy
+        if strategy == "gather":
+            lo, hi = nibble_tables(self.mat)
+            self._lo = jnp.asarray(lo)
+            self._hi = jnp.asarray(hi)
+        elif strategy == "bitmatrix":
+            self._bmat_np = gf.expand_bitmatrix(self.mat)
+            self._bmat = jnp.asarray(self._bmat_np, dtype=jnp.bfloat16)
+        elif strategy == "pallas":
+            self._bmat_np = gf.expand_bitmatrix(self.mat)
+        else:
+            raise ValueError(f"unknown strategy {strategy!r}")
+
+    def __call__(self, data: jax.Array) -> jax.Array:
+        if self.strategy == "gather":
+            return _apply_gather(self._lo, self._hi, data)
+        if self.strategy == "pallas":
+            return _pallas_apply(self._bmat_np, data)
+        return _apply_bitmatrix(self._bmat, data)
+
+
+def default_strategy() -> Strategy:
+    """Pick the lowering for the current default backend.
+
+    The MXU bit-matrix path wins on TPU (measured in bench.py); the
+    gather path is the portable fallback (CPU test mesh, older chips).
+    """
+    return "gather" if jax.default_backend() == "cpu" else "pallas"
+
+
+class TPUCodec:
+    """Systematic RS(k, m) over GF(2^8) on the JAX device path.
+
+    Same surface as rs_ref.ReferenceCodec (encode / encode_parity /
+    reconstruct / decode_data); shards are uint8 [..., rows, n] with
+    arbitrary leading batch dims — vmap is implicit via batched shapes.
+    Decode matrices per erasure pattern are cached.
+    """
+
+    def __init__(self, k: int, m: int, strategy: Strategy | None = None):
+        if k < 1 or m < 0 or k + m > gf.FIELD:
+            raise ValueError(f"invalid RS geometry k={k}, m={m}")
+        self.k = k
+        self.m = m
+        self.strategy = strategy or default_strategy()
+        self._parity_apply = _MatrixApply(gf.cauchy_parity_matrix(k, m), self.strategy)
+        self._cache: dict[tuple, _MatrixApply] = {}
+
+    # -- encode -------------------------------------------------------------
+    def encode_parity(self, data: jax.Array) -> jax.Array:
+        """[..., k, n] uint8 -> [..., m, n] parity shards."""
+        return self._parity_apply(jnp.asarray(data, dtype=jnp.uint8))
+
+    def encode(self, data: jax.Array) -> jax.Array:
+        """[..., k, n] -> [..., k+m, n] coded shards (systematic)."""
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        if data.shape[-2] != self.k:
+            raise ValueError(f"expected {self.k} data shards, got {data.shape[-2]}")
+        return jnp.concatenate([data, self.encode_parity(data)], axis=-2)
+
+    # -- decode -------------------------------------------------------------
+    def _matrix_for(self, kind: str, present: tuple[int, ...],
+                    missing: tuple[int, ...] = ()) -> _MatrixApply:
+        key = (kind, present, missing)
+        if key not in self._cache:
+            if kind == "decode":
+                mat = gf.decode_matrix(self.k, self.m, present)
+            else:
+                mat = gf.repair_matrix(self.k, self.m, present, missing)
+            self._cache[key] = _MatrixApply(mat, self.strategy)
+        return self._cache[key]
+
+    def reconstruct(self, survivors: jax.Array, present: tuple[int, ...],
+                    missing: tuple[int, ...] | None = None) -> jax.Array:
+        """Recover missing shards from any k survivors.
+
+        survivors: [..., k, n] rows ordered as ``present``; returns
+        [..., len(missing), n] (missing defaults to all absent rows).
+        """
+        present = tuple(present)
+        if missing is None:
+            missing = tuple(i for i in range(self.k + self.m) if i not in present)
+        apply_ = self._matrix_for("repair", present, tuple(missing))
+        return apply_(jnp.asarray(survivors, dtype=jnp.uint8))
+
+    def decode_data(self, survivors: jax.Array, present: tuple[int, ...]) -> jax.Array:
+        """Recover the k data shards from any k survivors."""
+        apply_ = self._matrix_for("decode", tuple(present))
+        return apply_(jnp.asarray(survivors, dtype=jnp.uint8))
+
+
+# ---------------------------------------------------------------------------
+# ErasureCodec factory — the trait boundary of the north star
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def make_codec(k: int, m: int, backend: str = "cpu", strategy: Strategy | None = None):
+    """The ``ErasureCodec`` gate: CPU path is the default, TPU opt-in.
+
+    Mirrors the north-star design (BASELINE.json): erasure coding is
+    gated behind a codec trait with the CPU reference implementation as
+    default and the JAX/TPU path selectable. backend: "cpu" | "native"
+    (C++ via ctypes) | "tpu"/"jax" | "auto" (tpu if a TPU is present).
+    """
+    if backend == "auto":
+        backend = "tpu" if jax.default_backend() != "cpu" else "cpu"
+    if backend == "cpu":
+        from .rs_ref import ReferenceCodec
+
+        return ReferenceCodec(k, m)
+    if backend == "native":
+        try:
+            from .rs_native import NativeCodec
+        except ImportError as e:
+            raise NotImplementedError(
+                "native (C++) ErasureCodec backend not built; run "
+                "`make -C cess_tpu/native` or use backend='cpu'"
+            ) from e
+        return NativeCodec(k, m)
+    if backend in ("tpu", "jax"):
+        return TPUCodec(k, m, strategy=strategy)
+    raise ValueError(f"unknown ErasureCodec backend {backend!r}")

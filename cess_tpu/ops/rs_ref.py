@@ -30,7 +30,6 @@ class ReferenceCodec:
         self.k = k
         self.m = m
         self.parity = gf.cauchy_parity_matrix(k, m)
-        self.generator = gf.systematic_generator(k, m)
 
     # -- core --------------------------------------------------------------
     def _apply(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:

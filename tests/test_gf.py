@@ -59,13 +59,12 @@ def test_cauchy_mds_property(k, m):
     import itertools
 
     g = gf.systematic_generator(k, m)
-    count = 0
-    for rows in itertools.combinations(range(k + m), k):
-        sub = g[list(rows)]
-        gf.gf_mat_inv(sub)  # raises if singular
-        count += 1
-        if count >= 60:  # cap the combinatorial sweep
-            break
+    patterns = list(itertools.combinations(range(k + m), k))
+    if len(patterns) > 60:  # cap the sweep, but sample across the whole space
+        rng = np.random.default_rng(k * 100 + m)
+        patterns = [patterns[i] for i in rng.choice(len(patterns), 60, replace=False)]
+    for rows in patterns:
+        gf.gf_mat_inv(g[list(rows)])  # raises if singular
 
 
 def test_bitmatrix_single_constant():
