@@ -1,0 +1,8 @@
+"""End-to-end data-plane pipelines (the framework's "model" layer).
+
+The flagship workload is the storage pipeline: a batch of 16 MiB
+segments is erasure-coded into fragments and PoDR2-tagged, mirroring
+the reference's OSS-gateway + TEE-worker off-chain compute
+(SURVEY.md §3.2) as one batched TPU program.
+"""
+from .pipeline import PipelineConfig, StoragePipeline  # noqa: F401

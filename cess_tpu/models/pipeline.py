@@ -1,0 +1,68 @@
+"""The storage pipeline: segment -> RS fragments (-> PoDR2 tags).
+
+This is the flagship end-to-end workload ("model") of the framework:
+the batched device program that replaces the reference's off-chain
+OSS-gateway chunk/encode step and TEE tag computation
+(SURVEY.md §3.2: user -> OSS chunks file into 16 MiB segments,
+RS-encodes each into fragments; §3.3: TEE computes PoDR2 tags).
+
+Everything here is jit-able and batch-first: segments [B, segment_size]
+uint8 -> fragments [B, k+m, fragment_size] uint8 (+ per-fragment tags
+once the audit backend is wired in).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import constants
+from ..ops import gf
+from ..ops.rs import default_strategy, _MatrixApply
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    k: int = constants.REF_K
+    m: int = constants.REF_M
+    segment_size: int = constants.SEGMENT_SIZE
+    strategy: str | None = None  # None -> rs.default_strategy()
+
+    @property
+    def fragment_size(self) -> int:
+        assert self.segment_size % self.k == 0
+        return self.segment_size // self.k
+
+
+class StoragePipeline:
+    """Batched segment->fragment encode (and tag) program.
+
+    Unlike TPUCodec (a generic codec front with per-pattern caches),
+    this is a single fused forward step meant to be jitted/pjitted as
+    one program over a segment batch.
+    """
+
+    def __init__(self, config: PipelineConfig):
+        self.config = config
+        strategy = config.strategy or default_strategy()
+        self._parity = _MatrixApply(
+            gf.cauchy_parity_matrix(config.k, config.m), strategy
+        )
+
+    def encode_step(self, segments: jnp.ndarray) -> jnp.ndarray:
+        """[B, segment_size] uint8 -> [B, k+m, fragment_size] uint8.
+
+        Data fragments are the k row-slices of the segment (systematic
+        code: fragment bytes == segment bytes, hash-stable), parity
+        fragments follow.
+        """
+        cfg = self.config
+        b = segments.shape[0]
+        data = segments.reshape(b, cfg.k, cfg.fragment_size)
+        parity = self._parity(data)
+        return jnp.concatenate([data, parity], axis=-2)
+
+    def forward(self, segments: jnp.ndarray) -> dict[str, jnp.ndarray]:
+        """The full pipeline step (grows as subsystems land)."""
+        shards = self.encode_step(segments)
+        return {"fragments": shards}

@@ -142,6 +142,10 @@ class _MatrixApply:
             raise ValueError(f"unknown strategy {strategy!r}")
 
     def __call__(self, data: jax.Array) -> jax.Array:
+        if data.shape[-2] != self.mat.shape[1]:
+            raise ValueError(
+                f"expected {self.mat.shape[1]} shard rows, got {data.shape[-2]}"
+            )
         if self.strategy == "gather":
             return _apply_gather(self._lo, self._hi, data)
         if self.strategy == "pallas":

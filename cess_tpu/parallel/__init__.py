@@ -1,0 +1,9 @@
+"""Multi-chip scale-out: mesh construction + sharded data-plane steps.
+
+The reference scales by process-level replication over libp2p
+(SURVEY.md §2.4); the TPU framework's data plane instead shards the
+segment batch across a ``jax.sharding.Mesh`` and lets XLA insert ICI
+collectives. The segment axis is embarrassingly parallel for encode;
+audit aggregation reduces with psum; repair gathers survivors.
+"""
+from .mesh import make_mesh, sharded_pipeline_step  # noqa: F401

@@ -1,0 +1,63 @@
+"""Device mesh + sharded storage-pipeline steps.
+
+Axes:
+- ``seg``  — the segment batch axis (data parallel; the reference's
+  "embarrassingly parallel along the segment axis" structure,
+  SURVEY.md §5 long-context note).
+- ``byte`` — the intra-fragment byte/chunk axis. GF column operations
+  are columnwise-independent, so encode shards cleanly; PoDR2
+  aggregation reduces over this axis with ``psum``.
+
+The data plane runs under ``shard_map`` so the per-device program is
+exactly the single-chip program (including Pallas kernels), with
+explicit collectives where the math needs them — the idiomatic
+JAX/TPU framing of the reference's work-distribution parallelism.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.pipeline import StoragePipeline
+
+
+def make_mesh(devices=None, seg: int | None = None, byte: int = 1) -> Mesh:
+    """Build a (seg, byte) mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if seg is None:
+        seg = n // byte
+    if seg * byte != n:
+        raise ValueError(f"mesh {seg}x{byte} != {n} devices")
+    arr = np.array(devices).reshape(seg, byte)
+    return Mesh(arr, axis_names=("seg", "byte"))
+
+
+def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
+    """jit a pipeline step sharded over (seg, byte).
+
+    Input: segments [B, k, n] uint8 (fragment-major layout; B divisible
+    by mesh 'seg', n by 128*'byte'). Output: fragments [B, k+m, n] with
+    the same sharding, plus a psum'd checksum exercising the audit-style
+    cross-'byte' reduction path.
+    """
+
+    def step(data):
+        out = pipeline._parity(data)
+        shards = jnp.concatenate([data, out], axis=-2)
+        # audit-style collective: per-segment byte checksum reduced over
+        # the sharded byte axis (placeholder for PoDR2 sigma/mu psum)
+        local = jnp.sum(shards.astype(jnp.int32), axis=-1)
+        total = jax.lax.psum(local, axis_name="byte")
+        return shards, total
+
+    mapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=P("seg", None, "byte"),
+        out_specs=(P("seg", None, "byte"), P("seg", None)),
+    )
+    return jax.jit(mapped)

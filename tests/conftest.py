@@ -4,11 +4,11 @@ Tests never require TPU hardware; multi-chip sharding is exercised on a
 virtual 8-device CPU mesh (the driver separately dry-run-compiles the
 multi-chip path via __graft_entry__.dryrun_multichip).
 
-Must run before jax is imported anywhere.
+Note: this box's axon sitecustomize registers the TPU plugin and
+overrides JAX_PLATFORMS env at interpreter start, so env vars alone
+don't stick — the programmatic config update below does.
 """
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
