@@ -17,7 +17,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from .. import constants
-from ..ops import gf
+from ..ops import gf, podr2
 from ..ops.rs import default_strategy, _MatrixApply
 
 
@@ -27,23 +27,31 @@ class PipelineConfig:
     m: int = constants.REF_M
     segment_size: int = constants.SEGMENT_SIZE
     strategy: str | None = None  # None -> rs.default_strategy()
+    sectors: int = podr2.SECTORS  # PoDR2 block geometry
 
     @property
     def fragment_size(self) -> int:
         assert self.segment_size % self.k == 0
         return self.segment_size // self.k
 
+    @property
+    def blocks_per_fragment(self) -> int:
+        return podr2.Podr2Params(self.sectors).blocks_for(self.fragment_size)
+
 
 class StoragePipeline:
-    """Batched segment->fragment encode (and tag) program.
+    """Batched segment->fragment encode + PoDR2 tag program.
 
     Unlike TPUCodec (a generic codec front with per-pattern caches),
     this is a single fused forward step meant to be jitted/pjitted as
-    one program over a segment batch.
+    one program over a segment batch. The tag step plays the
+    reference's TEE role (SURVEY.md §3.2 step "TEE worker computes
+    PoDR2 tags for fragments").
     """
 
-    def __init__(self, config: PipelineConfig):
+    def __init__(self, config: PipelineConfig, podr2_key: podr2.Podr2Key | None = None):
         self.config = config
+        self.podr2_key = podr2_key or podr2.Podr2Key.generate(0, podr2.Podr2Params(config.sectors))
         strategy = config.strategy or default_strategy()
         self._parity = _MatrixApply(
             gf.cauchy_parity_matrix(config.k, config.m), strategy
@@ -62,7 +70,22 @@ class StoragePipeline:
         parity = self._parity(data)
         return jnp.concatenate([data, parity], axis=-2)
 
-    def forward(self, segments: jnp.ndarray) -> dict[str, jnp.ndarray]:
-        """The full pipeline step (grows as subsystems land)."""
+    def tag_step(self, fragments: jnp.ndarray,
+                 fragment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
+        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks]."""
+        b, rows, n = fragments.shape
+        flat = fragments.reshape(b * rows, n)
+        if fragment_ids is None:
+            fragment_ids = jnp.arange(b * rows, dtype=jnp.int32)
+        else:
+            fragment_ids = fragment_ids.reshape(b * rows)
+        tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
+        return tags.reshape(b, rows, -1)
+
+    def forward(self, segments: jnp.ndarray,
+                fragment_ids: jnp.ndarray | None = None) -> dict[str, jnp.ndarray]:
+        """The full pipeline step: encode + tag (the reference's
+        OSS-encode + TEE-tag off-chain compute as one device program)."""
         shards = self.encode_step(segments)
-        return {"fragments": shards}
+        tags = self.tag_step(shards, fragment_ids)
+        return {"fragments": shards, "tags": tags}

@@ -1,0 +1,151 @@
+"""Mersenne prime field F_p, p = 2^31 - 1, in 32-bit lane arithmetic.
+
+TPUs have no native 64-bit integer path, so the PoDR2 field math
+(tags, proof aggregation, verification) runs entirely in uint32 with
+16-bit limb splitting and the M31 rotation identity (2^31 == 1 mod p,
+so multiplying by 2^k is a 31-bit rotation). Every op keeps all
+intermediates < 2^32 — exact, overflow-free, and pure VPU work.
+
+The same functions trace under JAX (device path) and execute eagerly
+on NumPy arrays (host oracle); tests/test_pfield.py checks both against
+Python bigint arithmetic.
+
+Why M31 and not GF(2^8): PoDR2 needs a field big enough that the
+Shacham-Waters MAC check sigma == sum(nu_i f_k(i)) + sum(alpha_j mu_j)
+has negligible forgery probability per element (~2^-31 here); the
+reference's own PoDR2 lives in its external TEE repos and only the
+on-chain contract (opaque proof blob <= SIGMA_MAX=2048 B,
+/root/reference/runtime/src/lib.rs:992) constrains the design.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+P = (1 << 31) - 1  # 2147483647, Mersenne prime M31
+MASK16 = 0xFFFF
+
+
+def _xp(x):
+    """numpy/jax dispatch: use the module of the input array."""
+    import jax
+
+    return jax.numpy if isinstance(x, jax.Array) else np
+
+
+def to_field(x):
+    """Reduce arbitrary uint32 values into [0, p)."""
+    xp = _xp(x)
+    x = x.astype(xp.uint32)
+    r = (x & P) + (x >> 31)  # < 2^31 + 1
+    return xp.where(r >= P, r - P, r)
+
+
+def addmod(a, b):
+    """(a + b) mod p for a, b in [0, p)."""
+    xp = _xp(a)
+    s = a.astype(xp.uint32) + b.astype(xp.uint32)  # < 2^32 - 2: no overflow
+    return xp.where(s >= P, s - P, s)
+
+
+def submod(a, b):
+    xp = _xp(a)
+    a = a.astype(xp.uint32)
+    b = b.astype(xp.uint32)
+    return xp.where(a >= b, a - b, a + P - b)
+
+
+def negmod(a):
+    xp = _xp(a)
+    a = a.astype(xp.uint32)
+    return xp.where(a == 0, a, P - a)
+
+
+def _rot16(x):
+    """x * 2^16 mod p for x in [0, p): 31-bit left-rotation by 16."""
+    return ((x << 16) & P) | (x >> 15)
+
+
+def mulmod(a, b):
+    """(a * b) mod p for a, b in [0, p), all intermediates < 2^32.
+
+    Limb split a = a1*2^16 + a0 (a1 < 2^15), same for b:
+    a*b = 2*a1*b1 + (a1*b0 + a0*b1)*2^16 + a0*b0  (mod p, 2^32 == 2).
+    """
+    xp = _xp(a)
+    a = a.astype(xp.uint32)
+    b = b.astype(xp.uint32)
+    a0, a1 = a & MASK16, a >> 16
+    b0, b1 = b & MASK16, b >> 16
+    t_hi = to_field(a1 * b1 * 2)          # a1*b1 < 2^30 -> *2 < 2^31
+    lo = to_field(a0 * b0)                # < 2^32
+    m1 = a1 * b0                          # < 2^31
+    m2 = a0 * b1                          # < 2^31
+    mid = addmod(_rot16(xp.where(m1 >= P, m1 - P, m1)),
+                 _rot16(xp.where(m2 >= P, m2 - P, m2)))
+    return addmod(addmod(t_hi, mid), lo)
+
+
+def summod(x, axis=-1):
+    """Exact modular sum along an axis; requires dim size <= 65535.
+
+    Values in [0, p) are limb-split so the plain uint32 sums cannot
+    overflow, then recombined mod p.
+    """
+    xp = _xp(x)
+    n = x.shape[axis]
+    if n > 65535:
+        raise ValueError(f"summod axis dim {n} > 65535; fold first")
+    x = x.astype(xp.uint32)
+    lo = xp.sum(x & MASK16, axis=axis, dtype=xp.uint32)   # <= n * (2^16-1) < 2^32
+    hi = xp.sum(x >> 16, axis=axis, dtype=xp.uint32)      # <= n * 2^15 < 2^31
+    return addmod(_rot16(to_field(hi)), to_field(lo))
+
+
+def dotmod(a, b, axis=-1):
+    """Modular dot product sum_i a_i * b_i along an axis."""
+    return summod(mulmod(a, b), axis=axis)
+
+
+def powmod(a: int, e: int) -> int:
+    """Host-side scalar pow (for matrix inversion / host checks)."""
+    return pow(int(a), int(e), P)
+
+
+def invmod(a: int) -> int:
+    if int(a) % P == 0:
+        raise ZeroDivisionError("inverse of 0 in F_p")
+    return pow(int(a), P - 2, P)
+
+
+# -- byte packing ----------------------------------------------------------
+#
+# Elements embed bytes injectively into [0, p). Width 2 (16-bit) divides
+# every power-of-two fragment size into whole blocks (8 MiB / 512 B
+# blocks exactly), which keeps the PoDR2 block grid aligned with the
+# reference's power-of-two segment/fragment geometry; width 3 (24-bit)
+# is denser but leaves remainder bytes on power-of-two sizes.
+
+BYTES_PER_ELEM = 2
+
+
+def pack_bytes(data, width: int = BYTES_PER_ELEM, xp=None):
+    """uint8 [..., width*L] -> uint32 field elements [..., L] (little-endian)."""
+    if xp is None:
+        xp = _xp(data)
+    *lead, n = data.shape
+    assert n % width == 0, f"byte length {n} not divisible by {width}"
+    assert 1 <= width <= 3  # width 4 would not embed into [0, p)
+    d = data.reshape(*lead, n // width, width).astype(xp.uint32)
+    out = d[..., 0]
+    for i in range(1, width):
+        out = out | (d[..., i] << (8 * i))
+    return out
+
+
+def unpack_bytes(elems, width: int = BYTES_PER_ELEM, xp=None):
+    """Inverse of pack_bytes: uint32 [..., L] (< 2^(8*width)) -> uint8."""
+    if xp is None:
+        xp = _xp(elems)
+    e = elems.astype(xp.uint32)
+    parts = xp.stack([(e >> (8 * i)) & 0xFF for i in range(width)], axis=-1)
+    return parts.reshape(*e.shape[:-1], e.shape[-1] * width).astype(xp.uint8)

@@ -4,14 +4,19 @@ Axes:
 - ``seg``  — the segment batch axis (data parallel; the reference's
   "embarrassingly parallel along the segment axis" structure,
   SURVEY.md §5 long-context note).
-- ``byte`` — the intra-fragment byte/chunk axis. GF column operations
-  are columnwise-independent, so encode shards cleanly; PoDR2
-  aggregation reduces over this axis with ``psum``.
+- ``byte`` — the intra-fragment byte/block axis. GF column operations
+  are columnwise-independent so encode shards cleanly; PoDR2 proof
+  aggregation (mu, sigma) reduces over this axis with ``psum`` — the
+  audit-path collective.
 
 The data plane runs under ``shard_map`` so the per-device program is
 exactly the single-chip program (including Pallas kernels), with
 explicit collectives where the math needs them — the idiomatic
 JAX/TPU framing of the reference's work-distribution parallelism.
+
+Topology invariance: PoDR2 PRF values are always generated for the
+full block range and sliced locally, so tags/proofs are bit-identical
+on any mesh shape (protocol invariant).
 """
 from __future__ import annotations
 
@@ -21,6 +26,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.pipeline import StoragePipeline
+from ..ops import pfield as pf
+from ..ops import podr2
 
 
 def make_mesh(devices=None, seg: int | None = None, byte: int = 1) -> Mesh:
@@ -37,27 +44,78 @@ def make_mesh(devices=None, seg: int | None = None, byte: int = 1) -> Mesh:
 
 
 def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
-    """jit a pipeline step sharded over (seg, byte).
+    """jit the FULL pipeline step sharded over (seg, byte).
 
-    Input: segments [B, k, n] uint8 (fragment-major layout; B divisible
-    by mesh 'seg', n by 128*'byte'). Output: fragments [B, k+m, n] with
-    the same sharding, plus a psum'd checksum exercising the audit-style
-    cross-'byte' reduction path.
+    Per step: RS-encode the segment batch, PoDR2-tag every fragment,
+    build an aggregated challenge proof (mu, sigma) per fragment with
+    cross-device psum over the sharded block axis, and TEE-verify it.
+
+    Inputs: segments [B, k, n] uint8 (fragment-major; B % mesh.seg == 0,
+            n % (byte * BLOCK_BYTES) == 0); fragment ids [B, k+m] int32
+            (protocol-level identifiers, sharded over 'seg'); challenge
+            (idx [c], nu [c]) from podr2.gen_challenge — a fresh one per
+            audit round (replicated traced inputs, NOT baked into the
+            program: a fixed challenge would let a prover store only the
+            challenged blocks).
+    Output: fragments [B, k+m, n] (sharded same as input),
+            tags [B, k+m, blocks] (block axis sharded over 'byte'),
+            ok [B, k+m] bool verification verdicts (replicated).
     """
+    cfg = pipeline.config
+    key = pipeline.podr2_key
+    sectors = key.alpha.shape[0]
+    byte_shards = mesh.shape["byte"]
+    blocks_total = cfg.blocks_per_fragment
+    assert blocks_total % byte_shards == 0, (
+        f"{blocks_total} blocks not divisible by byte axis {byte_shards}")
+    blocks_local = blocks_total // byte_shards
 
-    def step(data):
-        out = pipeline._parity(data)
-        shards = jnp.concatenate([data, out], axis=-2)
-        # audit-style collective: per-segment byte checksum reduced over
-        # the sharded byte axis (placeholder for PoDR2 sigma/mu psum)
-        local = jnp.sum(shards.astype(jnp.int32), axis=-1)
-        total = jax.lax.psum(local, axis_name="byte")
-        return shards, total
+    def step(data, ids2d, idx, nu):
+        b, k, n_local = data.shape
+        parity = pipeline._parity(data)
+        shards = jnp.concatenate([data, parity], axis=-2)      # [b, k+m, n_local]
+        rows = shards.shape[-2]
+        frag_ids = ids2d.reshape(b * rows)
+
+        # --- tag: global PRF, local slice --------------------------------
+        off = jax.lax.axis_index("byte") * blocks_local
+        m = podr2.fragment_to_elems(shards.reshape(b * rows, n_local),
+                                    sectors)                   # [F, bl_local, s]
+        f_all = jax.vmap(
+            lambda i: podr2.prf_elems(key.prf_key, i, blocks_total))(frag_ids)
+        f_loc = jax.lax.dynamic_slice_in_dim(f_all, off, blocks_local, axis=1)
+        tags = jax.vmap(podr2.tag_from_elems, in_axes=(None, 0, 0))(
+            key.alpha, f_loc, m)                               # [F, bl_local]
+
+        # --- prove: masked local partials, psum over 'byte' ---------------
+        in_range = (idx >= off) & (idx < off + blocks_local)
+        local_idx = jnp.clip(idx - off, 0, blocks_local - 1)
+        w = jnp.where(in_range, nu, 0).astype(jnp.uint32)      # [c]
+        m_c = jnp.take(m, local_idx, axis=1)                   # [F, c, s]
+        t_c = jnp.take(tags, local_idx, axis=1)                # [F, c]
+        mu_part = pf.summod(pf.mulmod(w[None, :, None], m_c), axis=1)   # [F, s]
+        sg_part = pf.summod(pf.mulmod(w[None, :], t_c), axis=1)         # [F]
+        # modular psum: plain psum can overflow only if byte_shards * p
+        # >= 2^32, i.e. >= 3 shards -> reduce in uint32 then re-fold
+        mu = pf.to_field(jax.lax.psum(mu_part & pf.MASK16, "byte")
+                         + pf._rot16(jax.lax.psum(mu_part >> 16, "byte")))
+        sigma = pf.to_field(jax.lax.psum(sg_part & pf.MASK16, "byte")
+                            + pf._rot16(jax.lax.psum(sg_part >> 16, "byte")))
+
+        # --- verify (TEE role) -------------------------------------------
+        f_c = jax.vmap(lambda fa: jnp.take(fa, idx, axis=0))(f_all)    # [F, c]
+        lhs = pf.summod(pf.mulmod(nu[None, :], f_c), axis=1)           # [F]
+        rhs = jax.vmap(lambda u: pf.dotmod(key.alpha, u, axis=0))(mu)  # [F]
+        ok = pf.addmod(lhs, rhs) == sigma
+
+        return (shards, tags.reshape(b, rows, blocks_local),
+                ok.reshape(b, rows))
 
     mapped = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=P("seg", None, "byte"),
-        out_specs=(P("seg", None, "byte"), P("seg", None)),
+        in_specs=(P("seg", None, "byte"), P("seg", None), P(), P()),
+        out_specs=(P("seg", None, "byte"), P("seg", None, "byte"),
+                   P("seg", None)),
     )
     return jax.jit(mapped)

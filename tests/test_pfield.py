@@ -1,0 +1,85 @@
+"""Exactness tests for the M31 field core vs Python bigint arithmetic."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cess_tpu.ops import pfield as pf
+
+
+def rand_field(shape, seed=0):
+    return np.random.default_rng(seed).integers(0, pf.P, shape, dtype=np.uint32)
+
+
+EDGE = np.array([0, 1, 2, pf.P - 1, pf.P - 2, 0xFFFF, 0x10000, 0x7FFF0000,
+                 (1 << 30), (1 << 30) + 12345], dtype=np.uint32)
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (pf.addmod, lambda a, b: (a + b) % pf.P),
+    (pf.submod, lambda a, b: (a - b) % pf.P),
+    (pf.mulmod, lambda a, b: (a * b) % pf.P),
+])
+def test_binary_ops_vs_bigint(op, pyop):
+    a = np.concatenate([EDGE, rand_field(500, 1)])
+    b = np.concatenate([EDGE[::-1], rand_field(500, 2)])
+    want = np.array([pyop(int(x), int(y)) for x, y in zip(a, b)], dtype=np.uint32)
+    np.testing.assert_array_equal(op(a, b), want)                      # numpy
+    np.testing.assert_array_equal(np.asarray(op(jnp.asarray(a), jnp.asarray(b))), want)  # jax
+
+
+def test_edge_products_exhaustive_near_p():
+    vals = np.array([pf.P - 1, pf.P - 2, pf.P - 3, 1 << 16, (1 << 16) - 1,
+                     (1 << 15), (1 << 30) + 7, 3], dtype=np.uint32)
+    for x in vals:
+        for y in vals:
+            got = int(pf.mulmod(np.array([x]), np.array([y]))[0])
+            assert got == (int(x) * int(y)) % pf.P
+
+
+def test_to_field():
+    x = np.array([0, pf.P, pf.P + 1, 0xFFFFFFFF, (1 << 31)], dtype=np.uint32)
+    want = np.array([int(v) % pf.P for v in x], dtype=np.uint32)
+    np.testing.assert_array_equal(pf.to_field(x), want)
+
+
+def test_summod_vs_bigint():
+    for n in [1, 7, 256, 1500, 65535]:
+        x = rand_field(n, seed=n)
+        want = sum(int(v) for v in x) % pf.P
+        assert int(pf.summod(x)) == want
+    with pytest.raises(ValueError):
+        pf.summod(np.zeros(65536, dtype=np.uint32))
+
+
+def test_summod_axis_and_jax():
+    x = rand_field((4, 300), seed=9)
+    want = np.array([sum(int(v) for v in row) % pf.P for row in x], dtype=np.uint32)
+    np.testing.assert_array_equal(pf.summod(x, axis=-1), want)
+    np.testing.assert_array_equal(np.asarray(pf.summod(jnp.asarray(x), axis=-1)), want)
+
+
+def test_dotmod():
+    a = rand_field(256, 3)
+    b = rand_field(256, 4)
+    want = sum(int(x) * int(y) for x, y in zip(a, b)) % pf.P
+    assert int(pf.dotmod(a, b)) == want
+
+
+def test_inv_pow():
+    for a in [1, 2, 12345, pf.P - 1]:
+        assert (pf.invmod(a) * a) % pf.P == 1
+    with pytest.raises(ZeroDivisionError):
+        pf.invmod(0)
+
+
+@pytest.mark.parametrize("width", [1, 2, 3])
+def test_pack_unpack_roundtrip(width):
+    data = np.random.default_rng(7).integers(0, 256, (2, 6 * 100), dtype=np.uint8)
+    elems = pf.pack_bytes(data, width)
+    assert elems.dtype == np.uint32 and elems.shape == (2, 600 // width)
+    assert elems.max() < (1 << (8 * width))
+    np.testing.assert_array_equal(pf.unpack_bytes(elems, width), data)
+    # jax path identical
+    np.testing.assert_array_equal(
+        np.asarray(pf.pack_bytes(jnp.asarray(data), width)), elems)

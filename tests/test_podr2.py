@@ -1,0 +1,95 @@
+"""PoDR2 scheme tests: completeness, soundness smoke, batching, oracle parity."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cess_tpu.ops import pfield as pf
+from cess_tpu.ops import podr2
+
+FRAG_BYTES = 4 * podr2.BLOCK_BYTES * 4  # 16 blocks, small for tests
+
+
+def make_fragments(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, FRAG_BYTES), dtype=np.uint8)
+
+
+def test_tag_shapes_and_determinism():
+    key = podr2.Podr2Key.generate(42)
+    frags = make_fragments(3)
+    ids = jnp.arange(3)
+    tags = podr2.tag_fragments(key, ids, frags)
+    blocks = podr2.Podr2Params().blocks_for(FRAG_BYTES)
+    assert tags.shape == (3, blocks)
+    tags2 = podr2.tag_fragments(key, ids, frags)
+    np.testing.assert_array_equal(np.asarray(tags), np.asarray(tags2))
+    # different key -> different tags
+    key2 = podr2.Podr2Key.generate(43)
+    assert not np.array_equal(np.asarray(tags),
+                              np.asarray(podr2.tag_fragments(key2, ids, frags)))
+
+
+def test_completeness_honest_proof_verifies():
+    key = podr2.Podr2Key.generate(7)
+    frags = make_fragments(4, seed=1)
+    ids = jnp.arange(4)
+    tags = podr2.tag_fragments(key, ids, frags)
+    blocks = tags.shape[1]
+    idx, nu = podr2.gen_challenge(b"round-1-randomness", blocks)
+    mu, sigma = podr2.prove_batch(jnp.asarray(frags), tags, idx, nu)
+    ok = podr2.verify_batch(key, ids, blocks, idx, nu, mu, sigma)
+    assert bool(np.all(np.asarray(ok))), "honest proofs must verify"
+
+
+def test_soundness_corrupted_data_fails():
+    key = podr2.Podr2Key.generate(7)
+    frags = make_fragments(2, seed=2)
+    ids = jnp.arange(2)
+    tags = podr2.tag_fragments(key, ids, frags)
+    blocks = tags.shape[1]
+    idx, nu = podr2.gen_challenge(b"round-2", blocks)
+    corrupted = frags.copy()
+    # flip one byte inside a challenged block
+    target_block = int(np.asarray(idx)[0])
+    corrupted[0, target_block * podr2.BLOCK_BYTES] ^= 0xFF
+    mu, sigma = podr2.prove_batch(jnp.asarray(corrupted), tags, idx, nu)
+    ok = np.asarray(podr2.verify_batch(key, ids, blocks, idx, nu, mu, sigma))
+    assert not ok[0], "proof over corrupted data must fail"
+    assert ok[1], "untouched fragment still verifies"
+
+
+def test_soundness_wrong_sigma_and_replay():
+    key = podr2.Podr2Key.generate(9)
+    frags = make_fragments(1, seed=3)
+    ids = jnp.arange(1)
+    tags = podr2.tag_fragments(key, ids, frags)
+    blocks = tags.shape[1]
+    idx, nu = podr2.gen_challenge(b"round-3", blocks)
+    mu, sigma = podr2.prove_batch(jnp.asarray(frags), tags, idx, nu)
+    bad_sigma = pf.addmod(sigma, jnp.ones_like(sigma))
+    ok = podr2.verify_batch(key, ids, blocks, idx, nu, mu, bad_sigma)
+    assert not bool(np.asarray(ok)[0])
+    # replaying the same proof against a different round's challenge fails
+    idx2, nu2 = podr2.gen_challenge(b"round-4", blocks)
+    ok2 = podr2.verify_batch(key, ids, blocks, idx2, nu2, mu, sigma)
+    assert not bool(np.asarray(ok2)[0])
+
+
+def test_proof_size_within_chain_cap():
+    from cess_tpu.constants import SIGMA_MAX
+
+    assert podr2.PROOF_BYTES <= SIGMA_MAX
+
+
+def test_tag_oracle_parity_numpy_bigint():
+    """Tag math matches a bigint reference implementation exactly."""
+    key = podr2.Podr2Key.generate(5)
+    frag = make_fragments(1, seed=4)[0]
+    tags = np.asarray(podr2.tag_fragment(key, 0, frag))
+    alpha = np.asarray(key.alpha)
+    m = np.asarray(podr2.fragment_to_elems(jnp.asarray(frag)))
+    f = np.asarray(podr2._prf_elems(key.prf_key, 0, m.shape[0]))
+    for b in range(m.shape[0]):
+        want = (int(f[b]) + sum(int(a) * int(x) for a, x in zip(alpha, m[b]))) % pf.P
+        assert int(tags[b]) == want
