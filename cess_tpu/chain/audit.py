@@ -1,0 +1,294 @@
+"""PoDR2 audit rounds (reference: c-pallets/audit).
+
+Validators' offchain workers build identical challenge snapshots; the
+chain accepts one at >=2/3 matching proposals; snapshotted miners
+submit aggregated proofs; a randomly assigned TEE verifies; rewards and
+escalating punishments apply; timeout sweeps run every block.
+
+Mirrors /root/reference/c-pallets/audit/src/lib.rs:
+save_challenge_info w/ 2/3 aggregation :377-425, generation_challenge
+:901-988, submit_proof :430-479, submit_verify_result :484-545,
+clear_challenge :614-655, clear_verify_mission :657-737, fault
+tolerance = 2 consecutive failures (constants.rs:1-3).
+
+The proof *content* here is the TPU PoDR2 scheme's (mu, sigma) blob
+(cess_tpu/ops/podr2.py, <= SIGMA_MAX bytes); the chain treats it as
+opaque, exactly like the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from .. import constants
+from .sminer import Sminer
+from .state import DispatchError, State
+
+PALLET = "audit"
+
+CHALLENGE_LIFE_BASE = 300      # blocks; + per-miner extension like the ref
+CHALLENGE_LIFE_PER_MINER = 1
+VERIFY_LIFE = constants.BLOCKS_PER_HOUR   # VerifyDuration = +1h (:395-411)
+
+
+@dataclasses.dataclass(frozen=True)
+class NetSnapshot:
+    total_reward: int
+    total_idle_space: int
+    total_service_space: int
+    random_indices: tuple[int, ...]     # challenged chunk indices
+    randoms: tuple[bytes, ...]          # 20-byte randoms per index
+
+
+@dataclasses.dataclass(frozen=True)
+class MinerSnapshot:
+    miner: str
+    idle_space: int
+    service_space: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ChallengeInfo:
+    net: NetSnapshot
+    miners: tuple[MinerSnapshot, ...]   # still-pending miners
+    start: int
+    challenge_deadline: int
+    verify_deadline: int
+    cleared: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ProveInfo:
+    miner: str
+    snapshot: MinerSnapshot
+    idle_proof: bytes
+    service_proof: bytes
+
+
+class Audit:
+    def __init__(self, state: State, sminer: Sminer, tee_worker=None,
+                 storage_handler=None, file_bank=None):
+        self.state = state
+        self.sminer = sminer
+        self.tee_worker = tee_worker        # runtime wiring
+        self.storage_handler = storage_handler
+        self.file_bank = file_bank
+
+    # -- session keys -------------------------------------------------------
+    def set_keys(self, validators: tuple[str, ...]) -> None:
+        """Session hook: the audit key set (lib.rs:1104-1142)."""
+        self.state.put(PALLET, "keys", tuple(validators))
+
+    def keys(self) -> tuple[str, ...]:
+        return self.state.get(PALLET, "keys", default=())
+
+    # -- challenge generation (OCW side; lib.rs:901-988) ---------------------
+    def generation_challenge(self) -> tuple[NetSnapshot, tuple[MinerSnapshot, ...]]:
+        """Deterministic snapshot every validator's OCW reproduces:
+        all positive miners + 46/1000 random chunk indices + randoms."""
+        miners = []
+        for w in self.sminer.all_miners():
+            m = self.sminer.miner(w)
+            # frozen miners still hold data and stay challenged; only
+            # exited/locked ones leave the audit set (lib.rs:901-988)
+            if m.state in ("positive", "frozen") \
+                    and (m.idle_space or m.service_space):
+                miners.append(MinerSnapshot(w, m.idle_space, m.service_space))
+        miners = tuple(miners[:constants.CHALLENGE_MINER_MAX])
+        seed = self.state.get("system", "randomness", default=b"")
+        n_chunks = constants.CHUNK_COUNT * constants.CHALLENGE_RATE_NUM \
+            // constants.CHALLENGE_RATE_DEN + 1   # 47 (:956-964)
+        indices = []
+        randoms = []
+        for i in range(n_chunks):
+            h = hashlib.sha256(seed + i.to_bytes(4, "little")).digest()
+            indices.append(int.from_bytes(h[:4], "little") % constants.CHUNK_COUNT)
+            randoms.append(h[4:4 + constants.CHALLENGE_RANDOM_LEN])
+        total = self.sminer.reward_pool_balance()
+        net = NetSnapshot(
+            total_reward=total,
+            total_idle_space=(self.storage_handler.total_idle_space()
+                              if self.storage_handler else 0),
+            total_service_space=(self.storage_handler.total_service_space()
+                                 if self.storage_handler else 0),
+            random_indices=tuple(indices), randoms=tuple(randoms))
+        return net, miners
+
+    @staticmethod
+    def snapshot_digest(net: NetSnapshot,
+                        miners: tuple[MinerSnapshot, ...]) -> bytes:
+        return hashlib.sha256(repr((net, miners)).encode()).digest()
+
+    # -- proposal aggregation (lib.rs:377-425) --------------------------------
+    def save_challenge_info(self, validator: str, net: NetSnapshot,
+                            miners: tuple[MinerSnapshot, ...]) -> None:
+        keys = self.keys()
+        if validator not in keys:
+            raise DispatchError("audit.NotAuditKey", validator)
+        if self.challenge() is not None:
+            raise DispatchError("audit.ChallengeInProgress")
+        digest = self.snapshot_digest(net, miners)
+        prev = self.state.get(PALLET, "voted", validator)
+        if prev == digest:
+            raise DispatchError("audit.AlreadyProposed")
+        count = self.state.get(PALLET, "proposal", digest, default=0) + 1
+        self.state.put(PALLET, "proposal", digest, count)
+        self.state.put(PALLET, "voted", validator, digest)
+        if count * 3 >= len(keys) * 2 and count > 0:
+            now = self.state.block
+            life = CHALLENGE_LIFE_BASE + CHALLENGE_LIFE_PER_MINER * len(miners)
+            self.state.put(PALLET, "challenge", ChallengeInfo(
+                net=net, miners=miners, start=now,
+                challenge_deadline=now + life,
+                verify_deadline=now + life + VERIFY_LIFE))
+            for (k,), _ in list(self.state.iter_prefix(PALLET, "proposal")):
+                self.state.delete(PALLET, "proposal", k)
+            for (k,), _ in list(self.state.iter_prefix(PALLET, "voted")):
+                self.state.delete(PALLET, "voted", k)
+            self.state.deposit_event(PALLET, "ChallengeStart", start=now,
+                                     miners=len(miners))
+
+    def challenge(self) -> ChallengeInfo | None:
+        return self.state.get(PALLET, "challenge")
+
+    # -- proofs (lib.rs:430-479) ----------------------------------------------
+    def submit_proof(self, miner: str, idle_proof: bytes,
+                     service_proof: bytes) -> None:
+        ch = self.challenge()
+        if ch is None or ch.cleared:
+            raise DispatchError("audit.NoChallenge")
+        if self.state.block > ch.challenge_deadline:
+            raise DispatchError("audit.ChallengeExpired")
+        if len(idle_proof) > constants.SIGMA_MAX \
+                or len(service_proof) > constants.SIGMA_MAX:
+            raise DispatchError("audit.ProofTooLarge")
+        snap = next((s for s in ch.miners if s.miner == miner), None)
+        if snap is None:
+            raise DispatchError("audit.NotChallengedMiner")
+        # pop own snapshot (:454-474)
+        self.state.put(PALLET, "challenge", dataclasses.replace(
+            ch, miners=tuple(s for s in ch.miners if s.miner != miner)))
+        tee = self._random_tee(miner)
+        missions = self.state.get(PALLET, "unverify", tee, default=())
+        if len(missions) >= constants.VERIFY_MISSION_MAX:
+            raise DispatchError("audit.TeeOverloaded", tee)
+        self.state.put(PALLET, "unverify", tee, missions + (ProveInfo(
+            miner=miner, snapshot=snap, idle_proof=idle_proof,
+            service_proof=service_proof),))
+        # submitting at all resets the missed-challenge strike ladder
+        self.state.delete(PALLET, "clear_strikes", miner)
+        self.state.deposit_event(PALLET, "SubmitProof", miner=miner, tee=tee)
+
+    def _random_tee(self, material: str) -> str:
+        tees = self.tee_worker.controller_list() if self.tee_worker else ()
+        if not tees:
+            raise DispatchError("audit.NoTeeWorker")
+        seed = self.state.get("system", "randomness", default=b"")
+        h = hashlib.sha256(seed + material.encode()).digest()
+        return sorted(tees)[int.from_bytes(h[:4], "little") % len(tees)]
+
+    # -- verification results (lib.rs:484-545) ---------------------------------
+    def submit_verify_result(self, tee: str, miner: str, idle_ok: bool,
+                             service_ok: bool) -> None:
+        missions = self.state.get(PALLET, "unverify", tee, default=())
+        mission = next((p for p in missions if p.miner == miner), None)
+        if mission is None:
+            raise DispatchError("audit.NonExistentMission")
+        rest = tuple(p for p in missions if p.miner != miner)
+        if rest:
+            self.state.put(PALLET, "unverify", tee, rest)
+        else:
+            self.state.delete(PALLET, "unverify", tee)
+        ch = self.challenge()
+        if ch is None:
+            return
+        if idle_ok and service_ok:
+            self.state.delete(PALLET, "fail_count", miner)
+            self.sminer.calculate_miner_reward(
+                miner, ch.net.total_reward, ch.net.total_idle_space,
+                ch.net.total_service_space, mission.snapshot.idle_space,
+                mission.snapshot.service_space)
+        else:
+            fails = self.state.get(PALLET, "fail_count", miner, default=0) + 1
+            self.state.put(PALLET, "fail_count", miner, fails)
+            if fails >= constants.AUDIT_FAULT_TOLERANCE:
+                if not idle_ok:
+                    self.sminer.idle_punish(miner)
+                if not service_ok:
+                    self.sminer.service_punish(miner)
+                self.state.delete(PALLET, "fail_count", miner)
+        if self.tee_worker:
+            self.tee_worker.record_work(tee,
+                                        mission.snapshot.service_space
+                                        + mission.snapshot.idle_space)
+        self.state.deposit_event(PALLET, "VerifyResult", miner=miner,
+                                 idle=idle_ok, service=service_ok)
+
+    # -- sweeps (on_initialize; lib.rs:340-345,614-737) --------------------------
+    def on_initialize(self) -> None:
+        ch = self.challenge()
+        if ch is None:
+            return
+        now = self.state.block
+        if not ch.cleared and now > ch.challenge_deadline:
+            self._clear_challenge(ch)
+            ch = self.challenge()
+            if ch is None:
+                return
+        if now > ch.verify_deadline:
+            extended = self._clear_verify_missions(ch)
+            if not extended:
+                self.state.delete(PALLET, "challenge")
+                self.state.delete(PALLET, "verify_extended")
+                self.state.deposit_event(PALLET, "ChallengeEnd", block=now)
+
+    def _clear_challenge(self, ch: ChallengeInfo) -> None:
+        """Non-submitters: escalating clear punish, 3rd strike = force
+        exit (:614-655)."""
+        for snap in ch.miners:
+            strikes = self.state.get(PALLET, "clear_strikes", snap.miner,
+                                     default=0) + 1
+            self.state.put(PALLET, "clear_strikes", snap.miner, strikes)
+            try:
+                self.sminer.clear_punish(snap.miner, strikes)
+            except DispatchError:
+                continue
+            if strikes >= 3:
+                if self.file_bank is not None:
+                    self.file_bank.force_miner_exit(snap.miner)
+                else:
+                    self.sminer.force_exit(snap.miner)
+                self.state.delete(PALLET, "clear_strikes", snap.miner)
+        self.state.put(PALLET, "challenge",
+                       dataclasses.replace(ch, miners=(), cleared=True))
+
+    def _clear_verify_missions(self, ch: ChallengeInfo) -> bool:
+        """Overdue TEEs: slash + credit punishment; missions reassign
+        ONCE to other TEEs with an extended window (:657-737). Returns
+        True if the challenge was extended for the reassigned work."""
+        pending = list(self.state.iter_prefix(PALLET, "unverify"))
+        if not pending:
+            return False
+        laggards = {tee for (tee,), _ in pending}
+        for tee in sorted(laggards):
+            if self.tee_worker:
+                self.tee_worker.punish_scheduler(tee)
+            self.state.delete(PALLET, "unverify", tee)
+        already_extended = self.state.get(PALLET, "verify_extended",
+                                          default=False)
+        others = sorted(set(self.tee_worker.controller_list() if
+                            self.tee_worker else ()) - laggards)
+        if already_extended or not others:
+            self.state.delete(PALLET, "verify_extended")
+            return False  # drop the missions; round ends
+        all_missions = [m for (_,), ms in pending for m in ms]
+        for i, mission in enumerate(all_missions):
+            target = others[i % len(others)]
+            cur = self.state.get(PALLET, "unverify", target, default=())
+            self.state.put(PALLET, "unverify", target, cur + (mission,))
+        self.state.put(PALLET, "verify_extended", True)
+        self.state.put(PALLET, "challenge", dataclasses.replace(
+            ch, verify_deadline=ch.verify_deadline + VERIFY_LIFE))
+        self.state.deposit_event(PALLET, "VerifyReassigned",
+                                 missions=len(all_missions))
+        return True

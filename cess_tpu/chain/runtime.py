@@ -1,0 +1,168 @@
+"""Composition root: pallets wired + block executive.
+
+Mirrors the reference runtime (SURVEY.md §2.2): construct_runtime
+composition with cross-pallet trait wiring, the Executive's
+on_initialize order (audit sweeps -> storage-handler lease sweep ->
+file-bank GC -> scheduler-credit rollover -> scheduler dispatch,
+runtime/src/lib.rs:1479-1540 §3.4), transactional extrinsic dispatch,
+and era rotation driving staking payouts + sminer reward tranches.
+
+Consensus (who authors blocks, epoch randomness) lives in
+cess_tpu/node; the runtime consumes randomness via
+("system", "randomness") exactly like the reference's
+ParentBlockRandomness.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from .. import constants
+from .audit import Audit
+from .balances import Balances
+from .cacher import Cacher
+from .file_bank import FileBank
+from .oss import Oss
+from .scheduler import Scheduler
+from .scheduler_credit import SchedulerCredit
+from .sminer import Sminer
+from .staking import Staking
+from .state import DispatchError, State
+from .storage_handler import StorageHandler
+from .tee_worker import TeeWorker
+
+ROOT = "root"
+
+# extrinsics only the root / scheduler origin may call
+ROOT_ONLY = {
+    "file_bank.calculate_end",
+    "file_bank.deal_timeout",
+    "file_bank.force_miner_exit",
+    "tee_worker.update_whitelist",
+    "tee_worker.pin_ias_signer",
+    "audit.set_keys",
+}
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    fragment_count: int = constants.FRAGMENT_COUNT
+    era_blocks: int = constants.EPOCH_DURATION_BLOCKS * constants.SESSIONS_PER_ERA
+    credit_period_blocks: int | None = None  # default: era_blocks
+
+
+class Runtime:
+    def __init__(self, config: RuntimeConfig | None = None):
+        self.config = config or RuntimeConfig()
+        s = self.state = State()
+        self.balances = Balances(s)
+        self.storage_handler = StorageHandler(s, self.balances)
+        self.sminer = Sminer(s, self.balances, self.storage_handler)
+        self.scheduler = Scheduler(s)
+        self.oss = Oss(s)
+        self.cacher = Cacher(s, self.balances)
+        self.staking = Staking(s, self.balances)
+        self.credit = SchedulerCredit(
+            s, self.config.credit_period_blocks or self.config.era_blocks)
+        self.tee_worker = TeeWorker(s, staking=self.staking,
+                                    credit=self.credit)
+        self.file_bank = FileBank(s, self.balances, self.storage_handler,
+                                  self.sminer, self.scheduler,
+                                  fragment_count=self.config.fragment_count,
+                                  oss=self.oss)
+        self.audit = Audit(s, self.sminer, tee_worker=self.tee_worker,
+                           storage_handler=self.storage_handler,
+                           file_bank=self.file_bank)
+        self.pallets = {
+            "balances": self.balances,
+            "storage_handler": self.storage_handler,
+            "sminer": self.sminer,
+            "scheduler": self.scheduler,
+            "oss": self.oss,
+            "cacher": self.cacher,
+            "staking": self.staking,
+            "scheduler_credit": self.credit,
+            "tee_worker": self.tee_worker,
+            "file_bank": self.file_bank,
+            "audit": self.audit,
+        }
+        self._update_randomness()
+
+    # -- dispatch --------------------------------------------------------------
+    def _resolve(self, call: str):
+        pallet_name, _, method_name = call.partition(".")
+        pallet = self.pallets.get(pallet_name)
+        fn = getattr(pallet, method_name, None)
+        if pallet is None or fn is None or method_name.startswith("_"):
+            raise DispatchError("system.UnknownCall", call)
+        return fn
+
+    def apply_extrinsic(self, origin: str, call: str, *args, **kwargs):
+        """Transactional dispatch; rolls back on DispatchError and
+        re-raises (tests assert on error names like assert_noop!)."""
+        fn = self._resolve(call)
+        if call in ROOT_ONLY:
+            if origin != ROOT:
+                raise DispatchError("system.BadOrigin", call)
+            call_args = args
+        else:
+            call_args = (origin, *args)
+        self.state.begin_tx()
+        try:
+            result = fn(*call_args, **kwargs)
+        except DispatchError:
+            self.state.rollback_tx()
+            raise
+        self.state.commit_tx()
+        return result
+
+    # -- block execution ---------------------------------------------------------
+    def _update_randomness(self) -> None:
+        prev = self.state.get("system", "randomness", default=b"genesis")
+        self.state.put("system", "randomness", hashlib.sha256(
+            prev + self.state.block.to_bytes(8, "little")).digest())
+
+    def set_randomness(self, randomness: bytes) -> None:
+        """Consensus hook: epoch/VRF randomness replaces the fallback
+        hash chain (reference ParentBlockRandomness)."""
+        self.state.put("system", "randomness", randomness)
+
+    def init_block(self) -> None:
+        """Advance one block and run on_initialize hooks in the
+        reference's construct_runtime order (§3.4)."""
+        self.state.archive_events()
+        self.state.block += 1
+        self._update_randomness()
+        self.audit.on_initialize()
+        dead = self.storage_handler.on_initialize()
+        self.file_bank.on_initialize(dead)
+        self.credit.on_initialize()
+        if self.state.block % self.config.era_blocks == 0:
+            era = self.staking.current_era()
+            self.staking.end_era(era)
+            self.sminer.release_reward_tranches()
+            # session rotation: audit keys follow the elected set
+            elected = self.staking.electable()
+            if elected:
+                self.audit.set_keys(tuple(elected))
+        for name, pallet, method, task_args in self.scheduler.take_due():
+            self.state.begin_tx()
+            try:
+                getattr(self.pallets[pallet], method)(*task_args)
+            except DispatchError as e:
+                self.state.rollback_tx()
+                self.state.deposit_event("scheduler", "TaskFailed",
+                                         name=name, error=e.name)
+            else:
+                self.state.commit_tx()
+
+    def run_to_block(self, n: int) -> None:
+        while self.state.block < n:
+            self.init_block()
+
+    def advance_blocks(self, n: int) -> None:
+        self.run_to_block(self.state.block + n)
+
+    # -- genesis helpers -----------------------------------------------------------
+    def fund(self, who: str, amount: int) -> None:
+        self.balances.mint(who, amount)

@@ -1,0 +1,124 @@
+"""Staking with CESS economics (reference: c-pallets/cess-staking).
+
+The reference forks Substrate pallet-staking, changing the reward
+schedule to a fixed yearly issuance split validator/sminer
+(238.5M / 477M DOLLARS year 1, x0.841 per year for 30 years) with the
+sminer share pushed into the sminer reward pool each era, and adding
+``slash_scheduler`` = 5% of MinValidatorBond for TEE punishment.
+Mirrors /root/reference/c-pallets/staking/src/: reward schedule
+pallet/impls.rs:452-474, end_era sminer issuance :430-449,
+slash_scheduler slashing.rs:694-705, config runtime/src/lib.rs:585-589.
+
+Nominator/era-exposure machinery is intentionally collapsed to
+validator self-bonds; the election itself is credit-weighted and lives
+in cess_tpu/node/consensus.py (the reference's VrfSolver).
+"""
+from __future__ import annotations
+
+from .. import constants
+from .balances import Balances
+from .sminer import REWARD_POOL
+from .state import DispatchError, State
+
+PALLET = "staking"
+TREASURY = "treasury"
+
+MIN_VALIDATOR_BOND = 1_000_000 * constants.DOLLARS   # runtime :585-589
+ERAS_PER_YEAR = 365 * 4   # 6-hour eras (1h epochs x 6 sessions)
+
+
+class Staking:
+    def __init__(self, state: State, balances: Balances):
+        self.state = state
+        self.balances = balances
+
+    # -- bonding --------------------------------------------------------------
+    def bond(self, who: str, amount: int) -> None:
+        if amount <= 0:
+            raise DispatchError("staking.InvalidAmount")
+        self.balances.reserve(who, amount)
+        self.state.put(PALLET, "bond", who, self.bonded(who) + amount)
+        self.state.deposit_event(PALLET, "Bonded", who=who, amount=amount)
+
+    def unbond(self, who: str, amount: int) -> None:
+        b = self.bonded(who)
+        if amount <= 0 or amount > b:
+            raise DispatchError("staking.InvalidAmount")
+        if who in self.validators() and b - amount < MIN_VALIDATOR_BOND:
+            raise DispatchError("staking.InsufficientBond",
+                                "would fall below MinValidatorBond")
+        self.balances.unreserve(who, amount)
+        self.state.put(PALLET, "bond", who, b - amount)
+
+    def bonded(self, who: str) -> int:
+        return self.state.get(PALLET, "bond", who, default=0)
+
+    def validate(self, who: str) -> None:
+        """Declare validator intent (needs MinValidatorBond)."""
+        if self.bonded(who) < MIN_VALIDATOR_BOND:
+            raise DispatchError("staking.InsufficientBond")
+        vals = self.validators()
+        if who not in vals:
+            self.state.put(PALLET, "validators", vals + (who,))
+
+    def chill(self, who: str) -> None:
+        vals = self.validators()
+        if who in vals:
+            self.state.put(PALLET, "validators",
+                           tuple(v for v in vals if v != who))
+
+    def validators(self) -> tuple[str, ...]:
+        return self.state.get(PALLET, "validators", default=())
+
+    def electable(self) -> list[str]:
+        """Stake floor for election: MIN_ELECTABLE_STAKE = 3M DOLLARS
+        (runtime/src/lib.rs:764-772)."""
+        return [v for v in self.validators()
+                if self.bonded(v) >= constants.MIN_ELECTABLE_STAKE]
+
+    # -- era rewards (impls.rs:430-474) -----------------------------------------
+    @staticmethod
+    def rewards_in_year(year: int) -> tuple[int, int]:
+        """(validator_total, sminer_total) issued across that year's
+        eras; x0.841 decay, 30-year horizon."""
+        if year >= constants.REWARD_YEARS:
+            return 0, 0
+        v = constants.VALIDATOR_REWARD_YEAR1
+        s = constants.SMINER_REWARD_YEAR1
+        for _ in range(year):
+            v = v * constants.REWARD_DECAY_NUM // constants.REWARD_DECAY_DEN
+            s = s * constants.REWARD_DECAY_NUM // constants.REWARD_DECAY_DEN
+        return v, s
+
+    def end_era(self, era_index: int) -> None:
+        """Mint the era's issuance: validator share pro-rata by bond,
+        sminer share into the reward pool."""
+        year = era_index // ERAS_PER_YEAR
+        v_year, s_year = self.rewards_in_year(year)
+        v_era = v_year // ERAS_PER_YEAR
+        s_era = s_year // ERAS_PER_YEAR
+        self.balances.mint(REWARD_POOL, s_era)
+        active = self.electable() or list(self.validators())
+        total_bond = sum(self.bonded(v) for v in active)
+        if total_bond > 0:
+            for v in active:
+                share = v_era * self.bonded(v) // total_bond
+                self.balances.mint(v, share)
+        self.state.put(PALLET, "era", era_index + 1)
+        self.state.deposit_event(PALLET, "EraPaid", era=era_index,
+                                 validator_payout=v_era, sminer_payout=s_era)
+
+    def current_era(self) -> int:
+        return self.state.get(PALLET, "era", default=0)
+
+    # -- scheduler slash (slashing.rs:694-705) ------------------------------------
+    def slash_scheduler(self, stash: str) -> None:
+        """5% of MinValidatorBond from the stash's bond -> treasury."""
+        amount = MIN_VALIDATOR_BOND * constants.SCHEDULER_SLASH_PERMILL // 1000
+        b = self.bonded(stash)
+        taken = min(b, amount)
+        if taken:
+            self.state.put(PALLET, "bond", stash, b - taken)
+            self.balances.slash_reserved(stash, taken, TREASURY)
+        self.state.deposit_event(PALLET, "SchedulerSlashed", stash=stash,
+                                 amount=taken)

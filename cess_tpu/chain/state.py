@@ -1,0 +1,138 @@
+"""Journaled key-value state with transactional extrinsic semantics.
+
+The reference runs on Substrate's overlay-changes storage with
+transactional rollback per extrinsic; this is the same contract in
+plain Python: ``get/put/delete`` over ``(pallet, item, *key)`` tuples,
+a journal of old values, and nested begin/commit/rollback marks.
+
+Discipline: stored values are treated as immutable — pallets write new
+instances (dataclasses.replace / new dicts) instead of mutating in
+place, so journal entries stay valid. ``get`` of a mutable value that
+the caller intends to modify must be followed by ``put``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Iterator
+
+
+class DispatchError(Exception):
+    """An extrinsic failed; the runtime rolls back its changes.
+
+    Mirrors FRAME's DispatchError: carries a module-scoped error name
+    (e.g. "sminer.InsufficientBalance") used by tests the way the
+    reference uses assert_noop! error matching.
+    """
+
+    def __init__(self, name: str, detail: str = ""):
+        self.name = name
+        self.detail = detail
+        super().__init__(f"{name}{': ' + detail if detail else ''}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    pallet: str
+    name: str
+    data: tuple  # (key, value) pairs, hashable for equality checks
+
+
+_TOMBSTONE = object()
+
+
+class State:
+    """The chain state: KV store + events + block context."""
+
+    EVENT_HISTORY_CAP = 10_000
+
+    def __init__(self):
+        self.kv: dict[tuple, Any] = {}
+        self.events: list[Event] = []          # current block (cleared per block)
+        self.event_history: list[tuple[int, Event]] = []  # (block, event), capped
+        self.block: int = 0
+        self._journal: list[tuple[tuple, Any]] = []  # (key, old or _TOMBSTONE)
+        self._tx_marks: list[tuple[int, int]] = []   # (journal len, events len)
+
+    # -- kv ----------------------------------------------------------------
+    def get(self, *key, default=None):
+        return self.kv.get(key, default)
+
+    def require(self, *key, err: str):
+        if key not in self.kv:
+            raise DispatchError(err, f"missing {key}")
+        return self.kv[key]
+
+    def contains(self, *key) -> bool:
+        return key in self.kv
+
+    def put(self, *key_and_value) -> None:
+        *key, value = key_and_value
+        key = tuple(key)
+        self._journal.append((key, self.kv.get(key, _TOMBSTONE)))
+        self.kv[key] = value
+
+    def delete(self, *key) -> None:
+        key = tuple(key)
+        if key in self.kv:
+            self._journal.append((key, self.kv[key]))
+            del self.kv[key]
+
+    def iter_prefix(self, *prefix) -> Iterator[tuple[tuple, Any]]:
+        """Iterate (suffix, value) for all keys under a prefix, sorted
+        (determinism: iteration order is part of consensus)."""
+        n = len(prefix)
+        items = [(k[n:], v) for k, v in self.kv.items()
+                 if len(k) > n and k[:n] == prefix]
+        items.sort(key=lambda kv: repr(kv[0]))
+        return iter(items)
+
+    def count_prefix(self, *prefix) -> int:
+        n = len(prefix)
+        return sum(1 for k in self.kv if len(k) > n and k[:n] == prefix)
+
+    # -- events ------------------------------------------------------------
+    def deposit_event(self, _pallet: str, _name: str, **data) -> None:
+        # leading-underscore positionals keep e.g. name=... usable as a field
+        self.events.append(Event(_pallet, _name, tuple(sorted(data.items()))))
+
+    def events_of(self, pallet: str, name: str | None = None) -> list[Event]:
+        """Match against the full (capped) history, oldest first."""
+        hist = [e for _, e in self.event_history] + self.events
+        return [e for e in hist
+                if e.pallet == pallet and (name is None or e.name == name)]
+
+    def archive_events(self) -> None:
+        """Block boundary: move current events into the rolling history."""
+        self.event_history.extend((self.block, e) for e in self.events)
+        if len(self.event_history) > self.EVENT_HISTORY_CAP:
+            del self.event_history[:len(self.event_history)
+                                   - self.EVENT_HISTORY_CAP]
+        self.events.clear()
+
+    # -- transactions -------------------------------------------------------
+    def begin_tx(self) -> None:
+        self._tx_marks.append((len(self._journal), len(self.events)))
+
+    def commit_tx(self) -> None:
+        self._tx_marks.pop()
+
+    def rollback_tx(self) -> None:
+        jmark, emark = self._tx_marks.pop()
+        while len(self._journal) > jmark:
+            key, old = self._journal.pop()
+            if old is _TOMBSTONE:
+                self.kv.pop(key, None)
+            else:
+                self.kv[key] = old
+        del self.events[emark:]
+
+    # -- roots --------------------------------------------------------------
+    def state_root(self) -> bytes:
+        """sha256 over the sorted key/value reprs (cheap determinism
+        check between replicas; not a Merkle trie)."""
+        h = hashlib.sha256()
+        for k in sorted(self.kv, key=repr):
+            h.update(repr(k).encode())
+            h.update(repr(self.kv[k]).encode())
+        return h.digest()

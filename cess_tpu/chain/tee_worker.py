@@ -1,0 +1,116 @@
+"""TEE "scheduler" worker registry (reference: c-pallets/tee-worker).
+
+Register with an attestation report verified on-chain (controller and
+stash binding, libp2p PeerId, PoDR2 public key); the network-wide
+PoDR2 key is the first registered worker's; an MRENCLAVE whitelist
+gates registration; punishment slashes the worker's stash via staking
+and records a scheduler-credit punishment.
+Mirrors /root/reference/c-pallets/tee-worker/src/lib.rs: register
+:138-177 (verify_miner_cert -> enclave-verify lib.rs:135-219),
+TeePodr2Pk :122-123, update_whitelist :210-218, ScheduleFind incl.
+punish_scheduler :294-321.
+
+Attestation format here: (payload, signature, signer_pubkey) where the
+signature must verify over payload with an RSA key whose fingerprint is
+in the pinned signer set (standing in for the pinned IAS root chain),
+and payload must embed the whitelisted MRENCLAVE and the registered
+PoDR2 key (binding the key to the enclave).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..crypto.rsa import RsaPublicKey, rsa_verify_pkcs1v15
+from .state import DispatchError, State
+
+PALLET = "tee_worker"
+
+
+@dataclasses.dataclass(frozen=True)
+class TeeWorkerInfo:
+    controller: str
+    stash: str
+    peer_id: bytes
+    podr2_pk: bytes
+
+
+class TeeWorker:
+    def __init__(self, state: State, staking=None, credit=None):
+        self.state = state
+        self.staking = staking          # runtime wiring
+        self.credit = credit
+
+    # -- governance ----------------------------------------------------------
+    def update_whitelist(self, mrenclave: bytes) -> None:
+        """Root: allow an enclave measurement (lib.rs:210-218)."""
+        wl = self.state.get(PALLET, "whitelist", default=())
+        if mrenclave not in wl:
+            self.state.put(PALLET, "whitelist", wl + (mrenclave,))
+
+    def pin_ias_signer(self, key: RsaPublicKey) -> None:
+        """Root: pin an attestation signer (the IAS root stand-in)."""
+        pins = self.state.get(PALLET, "ias_pins", default=())
+        self.state.put(PALLET, "ias_pins", pins + (key.fingerprint(),))
+
+    # -- registration (lib.rs:138-177) ----------------------------------------
+    def register(self, controller: str, stash: str, peer_id: bytes,
+                 podr2_pk: bytes, payload: bytes, signature: bytes,
+                 signer: RsaPublicKey) -> None:
+        if self.state.contains(PALLET, "worker", controller):
+            raise DispatchError("tee_worker.Registered")
+        if signer.fingerprint() not in self.state.get(PALLET, "ias_pins",
+                                                      default=()):
+            raise DispatchError("tee_worker.UntrustedSigner")
+        if not rsa_verify_pkcs1v15(signer, payload, signature):
+            raise DispatchError("tee_worker.VerifyCertFailed")
+        wl = self.state.get(PALLET, "whitelist", default=())
+        if not any(mr in payload for mr in wl):
+            raise DispatchError("tee_worker.NonTeeWorker",
+                                "MRENCLAVE not whitelisted")
+        if podr2_pk not in payload:
+            raise DispatchError("tee_worker.VerifyCertFailed",
+                                "podr2 key not bound in report")
+        self.state.put(PALLET, "worker", controller, TeeWorkerInfo(
+            controller=controller, stash=stash, peer_id=peer_id,
+            podr2_pk=podr2_pk))
+        # network PoDR2 key = first registered worker's (lib.rs:122-123)
+        if not self.state.contains(PALLET, "podr2_pk"):
+            self.state.put(PALLET, "podr2_pk", podr2_pk)
+        self.state.deposit_event(PALLET, "RegistrationTeeWorker",
+                                 controller=controller)
+
+    def exit(self, controller: str) -> None:
+        if not self.state.contains(PALLET, "worker", controller):
+            raise DispatchError("tee_worker.NonTeeWorker")
+        self.state.delete(PALLET, "worker", controller)
+        self.state.deposit_event(PALLET, "ExitTeeWorker",
+                                 controller=controller)
+
+    # -- queries ---------------------------------------------------------------
+    def worker(self, controller: str) -> TeeWorkerInfo | None:
+        return self.state.get(PALLET, "worker", controller)
+
+    def tee_podr2_pk(self) -> bytes | None:
+        return self.state.get(PALLET, "podr2_pk")
+
+    # -- ScheduleFind trait (lib.rs:287-321) -------------------------------------
+    def controller_list(self) -> tuple[str, ...]:
+        return tuple(k[0] for k, _ in self.state.iter_prefix(PALLET, "worker"))
+
+    def punish_scheduler(self, controller: str) -> None:
+        """Verify-timeout escalation: slash the stash 5% of the minimum
+        validator bond + credit punishment (staking slashing.rs:694-705)."""
+        w = self.worker(controller)
+        if w is None:
+            return
+        if self.staking is not None:
+            self.staking.slash_scheduler(w.stash)
+        if self.credit is not None:
+            self.credit.record_punishment(w.controller)
+        self.state.deposit_event(PALLET, "PunishScheduler",
+                                 controller=controller)
+
+    def record_work(self, controller: str, nbytes: int) -> None:
+        """Verified bytes feed the credit score (SchedulerCreditCounter)."""
+        if self.credit is not None and self.worker(controller) is not None:
+            self.credit.record_proceed_block_size(controller, nbytes)

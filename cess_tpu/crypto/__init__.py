@@ -1,0 +1,10 @@
+"""Host-side crypto for the chain layer.
+
+The reference vendors ring/webpki/verify-bls-signatures (Rust+C+asm,
+SURVEY.md §2.3) for SGX attestation verification, RSA message checks
+and BLS proof signatures. Here the host path is pure Python (RSA
+PKCS#1 v1.5 verify, SHA-2, Ed25519+VRF) with the batched field math on
+TPU; a C++ fast path can slot in behind the same functions.
+"""
+from .rsa import rsa_verify_pkcs1v15, RsaPublicKey  # noqa: F401
+from .hashing import sha256, blake2b_256  # noqa: F401

@@ -72,13 +72,21 @@ class StoragePipeline:
 
     def tag_step(self, fragments: jnp.ndarray,
                  fragment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
-        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks]."""
+        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks].
+
+        fragment_ids: unique-per-key ids ([B, k+m] or [B, k+m, 2] hash
+        word pairs, see podr2.fragment_id_from_hash). The arange default
+        is for benches/demos ONLY — production must pass hash-derived
+        ids, since id reuse across different data breaks unforgeability.
+        """
         b, rows, n = fragments.shape
         flat = fragments.reshape(b * rows, n)
         if fragment_ids is None:
             fragment_ids = jnp.arange(b * rows, dtype=jnp.int32)
         else:
-            fragment_ids = fragment_ids.reshape(b * rows)
+            fragment_ids = jnp.asarray(fragment_ids)
+            fragment_ids = fragment_ids.reshape(
+                (b * rows, 2) if fragment_ids.ndim == 3 else (b * rows,))
         tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
         return tags.reshape(b, rows, -1)
 

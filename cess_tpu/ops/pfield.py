@@ -32,19 +32,25 @@ def _xp(x):
     return jax.numpy if isinstance(x, jax.Array) else np
 
 
+def _cond_sub_p(xp, r):
+    """r - P where r >= P, else r — without evaluating an underflowing
+    branch (numpy's where computes both sides eagerly)."""
+    return r - (r >= P).astype(xp.uint32) * xp.uint32(P)
+
+
 def to_field(x):
     """Reduce arbitrary uint32 values into [0, p)."""
     xp = _xp(x)
     x = x.astype(xp.uint32)
     r = (x & P) + (x >> 31)  # < 2^31 + 1
-    return xp.where(r >= P, r - P, r)
+    return _cond_sub_p(xp, r)
 
 
 def addmod(a, b):
     """(a + b) mod p for a, b in [0, p)."""
     xp = _xp(a)
     s = a.astype(xp.uint32) + b.astype(xp.uint32)  # < 2^32 - 2: no overflow
-    return xp.where(s >= P, s - P, s)
+    return _cond_sub_p(xp, s)
 
 
 def submod(a, b):
@@ -80,8 +86,7 @@ def mulmod(a, b):
     lo = to_field(a0 * b0)                # < 2^32
     m1 = a1 * b0                          # < 2^31
     m2 = a0 * b1                          # < 2^31
-    mid = addmod(_rot16(xp.where(m1 >= P, m1 - P, m1)),
-                 _rot16(xp.where(m2 >= P, m2 - P, m2)))
+    mid = addmod(_rot16(_cond_sub_p(xp, m1)), _rot16(_cond_sub_p(xp, m2)))
     return addmod(addmod(t_hi, mid), lo)
 
 
@@ -104,6 +109,20 @@ def summod(x, axis=-1):
 def dotmod(a, b, axis=-1):
     """Modular dot product sum_i a_i * b_i along an axis."""
     return summod(mulmod(a, b), axis=axis)
+
+
+def psum_mod(x, axis_name: str):
+    """Exact modular psum across a mesh axis (JAX only).
+
+    Values in [0, p) are limb-split so plain uint32 psums cannot
+    overflow for any realistic device count (<= 65536), then recombined
+    mod p — the collective analog of summod.
+    """
+    import jax
+
+    lo = jax.lax.psum(x & MASK16, axis_name)
+    hi = jax.lax.psum(x >> 16, axis_name)
+    return to_field(lo + _rot16(to_field(hi)))
 
 
 def powmod(a: int, e: int) -> int:

@@ -77,20 +77,41 @@ class Podr2Key:
         return Podr2Key(alpha=alpha, prf_key=k_prf)
 
 
+def fragment_id_from_hash(fragment_hash: bytes) -> np.ndarray:
+    """Protocol fragment id = low 8 bytes of the on-chain fragment hash,
+    as a (lo, hi) uint32 pair (x32 mode cannot carry 64-bit scalars).
+
+    SECURITY CONTRACT: tag-gen ids must be unique per key — reusing an
+    id for different data under one key lets an adversary difference
+    two tag sets and solve for alpha. Hash-derived ids give uniqueness
+    for free (distinct fragments have distinct hashes).
+    """
+    v = int.from_bytes(fragment_hash[:8], "little")
+    return np.array([v & 0xFFFFFFFF, v >> 32], dtype=np.uint32)
+
+
 def prf_elems(prf_key, fragment_id, n: int):
     """f_k(fragment_id, 0..n-1): per-block PRF values in F_p.
 
-    threefry is counter-based and platform-deterministic, so CPU and
-    TPU paths agree bit-exactly (a protocol invariant, like the codec).
-    Always generated for the FULL block range of a fragment — sharded
-    executions slice their local range so tags are identical regardless
-    of mesh topology.
+    fragment_id is a (possibly 64-bit) integer, folded in as two 32-bit
+    words. threefry is counter-based and platform-deterministic, so CPU
+    and TPU paths agree bit-exactly (a protocol invariant, like the
+    codec). Always generated for the FULL block range of a fragment —
+    sharded executions slice their local range so tags are identical
+    regardless of mesh topology.
     """
-    key = jax.random.fold_in(prf_key, fragment_id)
+    if isinstance(fragment_id, int):
+        # split host-side: x32 mode truncates 64-bit device ints
+        lo = np.uint32(fragment_id & 0xFFFFFFFF)
+        hi = np.uint32((fragment_id >> 32) & 0xFFFFFFFF)
+    else:
+        fid = jnp.asarray(fragment_id)
+        if fid.ndim == 1 and fid.shape[0] == 2:   # (lo, hi) pair
+            lo, hi = fid[0].astype(jnp.uint32), fid[1].astype(jnp.uint32)
+        else:                                      # plain 32-bit scalar id
+            lo, hi = fid.astype(jnp.uint32), jnp.uint32(0)
+    key = jax.random.fold_in(jax.random.fold_in(prf_key, lo), hi)
     return pf.to_field(jax.random.bits(key, (n,), jnp.uint32))
-
-
-_prf_elems = prf_elems  # backwards-compat internal alias
 
 
 def tag_from_elems(alpha, f, m):
@@ -162,12 +183,18 @@ def prove_batch(fragments, tags, idx, nu, sectors: int = SECTORS):
     return jax.vmap(lambda d, t: prove(d, t, idx, nu, sectors))(fragments, tags)
 
 
+def verify_from_f(alpha, f, idx, nu, mu, sigma):
+    """The verification equation given precomputed PRF values f [blocks]
+    (shared by single-device verify and the sharded mesh step)."""
+    lhs = pf.dotmod(nu, jnp.take(f, idx, axis=0), axis=0)
+    rhs = pf.dotmod(alpha, mu, axis=0)
+    return pf.addmod(lhs, rhs) == sigma
+
+
 def verify(key: Podr2Key, fragment_id, num_blocks: int, idx, nu, mu, sigma):
     """TEE-side check; returns bool[] (scalar) per call — vmap for batches."""
-    f = _prf_elems(key.prf_key, fragment_id, num_blocks)
-    lhs = pf.dotmod(nu, jnp.take(f, idx, axis=0), axis=0)
-    rhs = pf.dotmod(key.alpha, mu, axis=0)
-    return pf.addmod(lhs, rhs) == sigma
+    f = prf_elems(key.prf_key, fragment_id, num_blocks)
+    return verify_from_f(key.alpha, f, idx, nu, mu, sigma)
 
 
 def verify_batch(key: Podr2Key, fragment_ids, num_blocks: int, idx, nu, mu, sigma):

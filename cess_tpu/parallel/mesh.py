@@ -95,18 +95,13 @@ def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
         t_c = jnp.take(tags, local_idx, axis=1)                # [F, c]
         mu_part = pf.summod(pf.mulmod(w[None, :, None], m_c), axis=1)   # [F, s]
         sg_part = pf.summod(pf.mulmod(w[None, :], t_c), axis=1)         # [F]
-        # modular psum: plain psum can overflow only if byte_shards * p
-        # >= 2^32, i.e. >= 3 shards -> reduce in uint32 then re-fold
-        mu = pf.to_field(jax.lax.psum(mu_part & pf.MASK16, "byte")
-                         + pf._rot16(jax.lax.psum(mu_part >> 16, "byte")))
-        sigma = pf.to_field(jax.lax.psum(sg_part & pf.MASK16, "byte")
-                            + pf._rot16(jax.lax.psum(sg_part >> 16, "byte")))
+        mu = pf.psum_mod(mu_part, "byte")
+        sigma = pf.psum_mod(sg_part, "byte")
 
         # --- verify (TEE role) -------------------------------------------
-        f_c = jax.vmap(lambda fa: jnp.take(fa, idx, axis=0))(f_all)    # [F, c]
-        lhs = pf.summod(pf.mulmod(nu[None, :], f_c), axis=1)           # [F]
-        rhs = jax.vmap(lambda u: pf.dotmod(key.alpha, u, axis=0))(mu)  # [F]
-        ok = pf.addmod(lhs, rhs) == sigma
+        ok = jax.vmap(
+            lambda fa, u, s: podr2.verify_from_f(key.alpha, fa, idx, nu, u, s)
+        )(f_all, mu, sigma)
 
         return (shards, tags.reshape(b, rows, blocks_local),
                 ok.reshape(b, rows))

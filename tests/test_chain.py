@@ -1,0 +1,428 @@
+"""State-machine tests for the chain layer: drive extrinsics against an
+in-memory runtime, assert storage + events + error names — the
+reference's per-pallet mock-runtime test style (SURVEY.md §4), plus
+flows the reference leaves to live networks (deal timeout, audit
+escalation, restoral market).
+"""
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain.file_bank import UserBrief
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.state import DispatchError
+
+D = constants.DOLLARS
+MIB = constants.MIB
+FRAG = constants.FRAGMENT_SIZE
+
+ALICE, BOB = "alice", "bob"
+MINERS = ["m1", "m2", "m3", "m4", "m5"]
+FILE = b"\x11" * 32
+
+
+def seg_hashes(n, salt=b"s"):
+    return [(salt + bytes([i]) + b"seg" + b"\0" * 28,
+             tuple(salt + bytes([i, j]) + b"frag" + b"\0" * 26
+                   for j in range(3)))
+            for i in range(n)]
+
+
+@pytest.fixture
+def rt():
+    rt = Runtime(RuntimeConfig(era_blocks=50))
+    for a in (ALICE, BOB):
+        rt.fund(a, 10_000_000 * D)
+    for w in MINERS:
+        rt.fund(w, 10_000 * D)
+        rt.apply_extrinsic(w, "sminer.regnstk", w, b"peer" + w.encode(),
+                           2000 * D)
+        rt.apply_extrinsic(w, "file_bank.upload_filler", 4000)  # ~31 GiB idle
+    rt.apply_extrinsic(ALICE, "storage_handler.buy_space", 20)
+    rt.apply_extrinsic(ALICE, "file_bank.create_bucket", ALICE, "bkt")
+    return rt
+
+
+def declare(rt, who=ALICE, file_hash=FILE, segs=2):
+    rt.apply_extrinsic(who, "file_bank.upload_declaration", file_hash,
+                       seg_hashes(segs), UserBrief(who, "f.txt", "bkt"),
+                       segs * 16 * MIB)
+
+
+def complete_deal(rt, file_hash=FILE):
+    deal = rt.file_bank.deal(file_hash)
+    for w in deal.assigned:
+        rt.apply_extrinsic(w, "file_bank.transfer_report", file_hash)
+    rt.apply_extrinsic("root", "file_bank.calculate_end", file_hash)
+
+
+# -- storage handler ---------------------------------------------------------
+
+def test_buy_expand_renew_space(rt):
+    own = rt.storage_handler.owned_space(ALICE)
+    assert own.total_space == 20 * constants.GIB
+    assert rt.balances.free("treasury") == 20 * 30 * D
+    rt.apply_extrinsic(ALICE, "storage_handler.expansion_space", 10)
+    assert rt.storage_handler.owned_space(ALICE).total_space == 30 * constants.GIB
+    deadline0 = rt.storage_handler.owned_space(ALICE).deadline
+    rt.apply_extrinsic(ALICE, "storage_handler.renewal_space", 30)
+    assert rt.storage_handler.owned_space(ALICE).deadline \
+        == deadline0 + 30 * constants.ONE_DAY_BLOCKS
+    with pytest.raises(DispatchError, match="PurchasedSpace"):
+        rt.apply_extrinsic(ALICE, "storage_handler.buy_space", 1)
+
+
+def test_buy_space_capped_by_idle(rt):
+    # total idle = 5 miners x 4000 fillers x 8 MiB = 156.25 GiB; alice has 20
+    with pytest.raises(DispatchError, match="InsufficientAvailableSpace"):
+        rt.apply_extrinsic(BOB, "storage_handler.buy_space", 1000)
+
+
+def test_lease_freeze_and_death_gc(rt):
+    declare(rt)
+    complete_deal(rt)
+    own = rt.storage_handler.owned_space(ALICE)
+    rt.run_to_block(own.deadline + 1)
+    assert rt.storage_handler.owned_space(ALICE).state == "frozen"
+    rt.advance_blocks(10 * constants.ONE_DAY_BLOCKS + 2)
+    # dead lease: files GC'd, ledger removed
+    assert rt.file_bank.file(FILE) is None
+    assert rt.storage_handler.owned_space(ALICE) is None
+
+
+# -- sminer -------------------------------------------------------------------
+
+def test_register_and_collateral(rt):
+    m = rt.sminer.miner("m1")
+    assert m.collateral == 2000 * D and m.state == "positive"
+    assert rt.balances.reserved("m1") == 2000 * D
+    with pytest.raises(DispatchError, match="AlreadyRegistered"):
+        rt.apply_extrinsic("m1", "sminer.regnstk", "m1", b"p", 2000 * D)
+    with pytest.raises(DispatchError, match="CollateralNotUp"):
+        rt.apply_extrinsic("nm", "sminer.regnstk", "nm", b"p", 1 * D)
+
+
+def test_punish_freeze_and_recover(rt):
+    rt.fund("m1", 10_000 * D)
+    rt.sminer.deposit_punish("m1", 1500 * D)
+    m = rt.sminer.miner("m1")
+    assert m.state == "frozen" and m.collateral == 500 * D
+    assert rt.balances.free("sminer_reward_pool") == 1500 * D
+    rt.apply_extrinsic("m1", "sminer.increase_collateral", 1500 * D)
+    assert rt.sminer.miner("m1").state == "positive"
+
+
+def test_punish_beyond_collateral_creates_debt(rt):
+    rt.sminer.deposit_punish("m2", 3000 * D)
+    m = rt.sminer.miner("m2")
+    assert m.collateral == 0 and m.debt == 1000 * D and m.state == "frozen"
+
+
+# -- file bank ----------------------------------------------------------------
+
+def test_upload_lifecycle(rt):
+    declare(rt)
+    deal = rt.file_bank.deal(FILE)
+    assert len(deal.assigned) == 3
+    locked = rt.storage_handler.owned_space(ALICE).locked_space
+    assert locked == 2 * 16 * MIB * 3 // 2
+    for w in deal.assigned:
+        assert rt.sminer.miner(w).lock_space == 2 * FRAG
+    # duplicate declaration while deal pending
+    with pytest.raises(DispatchError, match="DealExists"):
+        declare(rt)
+    for w in deal.assigned:
+        rt.apply_extrinsic(w, "file_bank.transfer_report", FILE)
+    f = rt.file_bank.file(FILE)
+    assert f.state == "calculate"
+    own = rt.storage_handler.owned_space(ALICE)
+    assert own.locked_space == 0 and own.used_space == locked
+    rt.apply_extrinsic("root", "file_bank.calculate_end", FILE)
+    f = rt.file_bank.file(FILE)
+    assert f.state == "active"
+    for w in deal.assigned:
+        m = rt.sminer.miner(w)
+        assert m.lock_space == 0 and m.service_space == 2 * FRAG
+    assert rt.storage_handler.total_service_space() == 3 * 2 * FRAG
+    assert rt.file_bank.deal(FILE) is None
+
+
+def test_upload_dedup_adds_owner(rt):
+    declare(rt)
+    complete_deal(rt)
+    rt.apply_extrinsic(BOB, "storage_handler.buy_space", 10)
+    rt.apply_extrinsic(BOB, "file_bank.create_bucket", BOB, "bkt")
+    rt.apply_extrinsic(BOB, "file_bank.upload_declaration", FILE,
+                       seg_hashes(2), UserBrief(BOB, "f.txt", "bkt"),
+                       2 * 16 * MIB)
+    f = rt.file_bank.file(FILE)
+    assert {o.user for o in f.owners} == {ALICE, BOB}
+    ev = rt.state.events_of("file_bank", "UploadDeclaration")
+    assert dict(ev[-1].data)["shared"] is True
+    with pytest.raises(DispatchError, match="OwnedFile"):
+        rt.apply_extrinsic(BOB, "file_bank.upload_declaration", FILE,
+                           seg_hashes(2), UserBrief(BOB, "g", "bkt"),
+                           2 * 16 * MIB)
+
+
+def test_delete_file_frees_space(rt):
+    declare(rt)
+    complete_deal(rt)
+    deal_miners = rt.file_bank.file(FILE).miners
+    rt.apply_extrinsic(ALICE, "file_bank.delete_file", ALICE, FILE)
+    assert rt.file_bank.file(FILE) is None
+    assert rt.storage_handler.owned_space(ALICE).used_space == 0
+    for w in deal_miners:
+        assert rt.sminer.miner(w).service_space == 0
+
+
+def test_deal_timeout_reassign_and_abort(rt):
+    declare(rt)
+    deal0 = rt.file_bank.deal(FILE)
+    rt.apply_extrinsic(deal0.assigned[0], "file_bank.transfer_report", FILE)
+    life = constants.DEAL_TIMEOUT_BLOCKS * 3
+    for retry in range(1, constants.DEAL_MAX_RETRIES + 1):
+        rt.advance_blocks(life + 1)
+        deal = rt.file_bank.deal(FILE)
+        assert deal is not None and deal.count == retry
+        assert deal0.assigned[0] in deal.complete  # reporter kept
+    rt.advance_blocks(life + 1)
+    assert rt.file_bank.deal(FILE) is None  # aborted after 5 retries
+    assert rt.storage_handler.owned_space(ALICE).locked_space == 0
+    for w in MINERS:
+        assert rt.sminer.miner(w).lock_space == 0
+    assert rt.state.events_of("file_bank", "DealAborted")
+
+
+def test_permission_via_oss(rt):
+    gw = "gateway"
+    rt.fund(gw, 100 * D)
+    rt.apply_extrinsic(gw, "oss.register", b"gwpeer", "gw.example")
+    with pytest.raises(DispatchError, match="NoPermission"):
+        rt.apply_extrinsic(gw, "file_bank.upload_declaration", FILE,
+                           seg_hashes(1), UserBrief(ALICE, "f", "bkt"),
+                           16 * MIB)
+    rt.apply_extrinsic(ALICE, "oss.authorize", gw)
+    rt.apply_extrinsic(gw, "file_bank.upload_declaration", FILE,
+                       seg_hashes(1), UserBrief(ALICE, "f", "bkt"), 16 * MIB)
+    assert rt.file_bank.deal(FILE) is not None
+
+
+def test_ownership_transfer(rt):
+    declare(rt)
+    complete_deal(rt)
+    rt.apply_extrinsic(BOB, "storage_handler.buy_space", 10)
+    rt.apply_extrinsic(BOB, "file_bank.create_bucket", BOB, "bkt2")
+    rt.apply_extrinsic(ALICE, "file_bank.ownership_transfer", ALICE,
+                       UserBrief(BOB, "f.txt", "bkt2"), FILE)
+    f = rt.file_bank.file(FILE)
+    assert [o.user for o in f.owners] == [BOB]
+    assert rt.storage_handler.owned_space(ALICE).used_space == 0
+    assert rt.storage_handler.owned_space(BOB).used_space == f.needed_space
+
+
+# -- audit ---------------------------------------------------------------------
+
+def setup_tee(rt, controller="tee1", stash="stash1"):
+    from cess_tpu.crypto.rsa import generate_rsa_keypair
+
+    kp = generate_rsa_keypair(1024, seed=1)
+    rt.fund(stash, 3_000_000 * D)
+    rt.apply_extrinsic(stash, "staking.bond", 2_000_000 * D)
+    mrenclave = b"enclave-measure-1"
+    rt.apply_extrinsic("root", "tee_worker.update_whitelist", mrenclave)
+    rt.apply_extrinsic("root", "tee_worker.pin_ias_signer", kp.public)
+    podr2_pk = b"podr2-public-key"
+    payload = b"report:" + mrenclave + b":" + podr2_pk
+    sig = kp.sign_pkcs1v15(payload)
+    rt.apply_extrinsic(controller, "tee_worker.register", stash,
+                       b"teepeer", podr2_pk, payload, sig, kp.public)
+    return kp
+
+
+def start_challenge(rt, validators=("v1", "v2", "v3")):
+    rt.audit.set_keys(tuple(validators))
+    net, miners = rt.audit.generation_challenge()
+    for v in validators[:2]:  # 2/3
+        rt.apply_extrinsic(v, "audit.save_challenge_info", net, miners)
+    assert rt.audit.challenge() is not None
+    return net, miners
+
+
+def test_audit_round_reward(rt):
+    setup_tee(rt)
+    declare(rt)
+    complete_deal(rt)
+    rt.fund("sminer_reward_pool", 1000 * D)
+    net, miners = start_challenge(rt)
+    target = rt.file_bank.file(FILE).miners[0]
+    rt.apply_extrinsic(target, "audit.submit_proof", b"ip", b"sp")
+    ch = rt.audit.challenge()
+    assert all(s.miner != target for s in ch.miners)
+    ev = dict(rt.state.events_of("audit", "SubmitProof")[-1].data)
+    assert ev["tee"] == "tee1"
+    bal0 = rt.balances.free(target)
+    rt.apply_extrinsic("tee1", "audit.submit_verify_result", target,
+                       True, True)
+    assert rt.balances.free(target) > bal0  # 20% immediate payout
+    orders = rt.state.get("sminer", "reward_orders", target)
+    assert orders and orders[0].tranches_left == constants.RELEASE_NUMBER
+
+
+def test_audit_fail_punish_after_tolerance(rt):
+    setup_tee(rt)
+    declare(rt)
+    complete_deal(rt)
+    target = rt.file_bank.file(FILE).miners[0]
+    collateral0 = rt.sminer.miner(target).collateral
+    for i in range(constants.AUDIT_FAULT_TOLERANCE):
+        start_challenge(rt)
+        rt.apply_extrinsic(target, "audit.submit_proof", b"ip", b"sp")
+        rt.apply_extrinsic("tee1", "audit.submit_verify_result", target,
+                           False, True)
+        ch = rt.audit.challenge()
+        rt.run_to_block(ch.verify_deadline + 1)  # end round
+    assert rt.sminer.miner(target).collateral < collateral0
+
+
+def test_audit_clear_punish_escalation_and_force_exit(rt):
+    setup_tee(rt)
+    declare(rt)
+    complete_deal(rt)
+    strikes_seen = []
+    for round_i in range(3):
+        net, miners = start_challenge(rt)
+        ch = rt.audit.challenge()
+        rt.run_to_block(ch.verify_deadline + 1)  # nobody submits
+        strikes_seen.append(
+            rt.state.get("audit", "clear_strikes", MINERS[0], default=0))
+    # after 3 missed rounds every snapshotted miner was force-exited
+    target = rt.file_bank.file(FILE).miners[0]
+    assert rt.sminer.miner(target).state == "locked"
+    # its fragments became restoral orders
+    orders = [v for k, v in rt.state.iter_prefix("file_bank", "restoral")]
+    assert any(o.origin_miner == target for o in orders)
+
+
+def test_audit_proposal_needs_two_thirds(rt):
+    rt.audit.set_keys(("v1", "v2", "v3"))
+    net, miners = rt.audit.generation_challenge()
+    rt.apply_extrinsic("v1", "audit.save_challenge_info", net, miners)
+    assert rt.audit.challenge() is None
+    with pytest.raises(DispatchError, match="NotAuditKey"):
+        rt.apply_extrinsic("vX", "audit.save_challenge_info", net, miners)
+    rt.apply_extrinsic("v2", "audit.save_challenge_info", net, miners)
+    assert rt.audit.challenge() is not None
+
+
+def test_tee_verify_timeout_slashes_scheduler(rt):
+    setup_tee(rt)
+    declare(rt)
+    complete_deal(rt)
+    start_challenge(rt)
+    target = rt.file_bank.file(FILE).miners[0]
+    rt.apply_extrinsic(target, "audit.submit_proof", b"ip", b"sp")
+    bond0 = rt.staking.bonded("stash1")
+    ch = rt.audit.challenge()
+    rt.run_to_block(ch.verify_deadline + 1)
+    assert rt.staking.bonded("stash1") < bond0
+    assert rt.state.events_of("tee_worker", "PunishScheduler")
+
+
+# -- restoral + exit -----------------------------------------------------------
+
+def test_restoral_order_flow(rt):
+    declare(rt)
+    complete_deal(rt)
+    f = rt.file_bank.file(FILE)
+    victim = f.miners[0]
+    frag = f.segments[0].fragment_hashes[0]
+    rt.apply_extrinsic(victim, "file_bank.generate_restoral_order", FILE, frag)
+    rescuer = next(w for w in MINERS if w not in f.miners) \
+        if len(MINERS) > 3 else f.miners[1]
+    rt.apply_extrinsic(rescuer, "file_bank.claim_restoral_order", frag)
+    with pytest.raises(DispatchError, match="OrderClaimed"):
+        rt.apply_extrinsic(f.miners[1], "file_bank.claim_restoral_order", frag)
+    sv0 = rt.sminer.miner(rescuer).service_space
+    rt.apply_extrinsic(rescuer, "file_bank.restoral_order_complete", frag)
+    assert rt.sminer.miner(rescuer).service_space == sv0 + FRAG
+    assert rt.sminer.miner(victim).service_space == 2 * FRAG - FRAG
+    assert rt.file_bank.restoral_order(frag) is None
+
+
+def test_miner_exit_withdraw(rt):
+    declare(rt)
+    complete_deal(rt)
+    f = rt.file_bank.file(FILE)
+    leaver = f.miners[0]
+    rt.apply_extrinsic(leaver, "file_bank.miner_exit_prep")
+    tgt = rt.file_bank.restoral_target(leaver)
+    assert tgt.service_space == 2 * FRAG
+    with pytest.raises(DispatchError, match="CoolingNotOver"):
+        rt.apply_extrinsic(leaver, "file_bank.miner_withdraw")
+    # other miners restore both fragments
+    rescuer = next(w for w in MINERS if w not in f.miners)
+    for seg in f.segments:
+        frag = seg.fragment_hashes[0]
+        rt.apply_extrinsic(rescuer, "file_bank.claim_restoral_order", frag)
+        rt.apply_extrinsic(rescuer, "file_bank.restoral_order_complete", frag)
+    rt.advance_blocks(constants.ONE_DAY_BLOCKS + 1)
+    free0 = rt.balances.free(leaver)
+    rt.apply_extrinsic(leaver, "file_bank.miner_withdraw")
+    assert rt.balances.free(leaver) == free0 + 2000 * D
+    assert rt.sminer.miner(leaver) is None
+
+
+# -- economics ------------------------------------------------------------------
+
+def test_era_payout_and_reward_tranches(rt):
+    rt.fund("val", 4_000_000 * D)
+    rt.apply_extrinsic("val", "staking.bond", 3_500_000 * D)
+    rt.apply_extrinsic("val", "staking.validate")
+    free0 = rt.balances.free("val")
+    pool0 = rt.balances.free("sminer_reward_pool")
+    rt.advance_blocks(50)  # one era
+    assert rt.balances.free("val") > free0
+    assert rt.balances.free("sminer_reward_pool") > pool0
+
+
+def test_reward_decay_schedule():
+    from cess_tpu.chain.staking import Staking
+
+    v0, s0 = Staking.rewards_in_year(0)
+    v1, s1 = Staking.rewards_in_year(1)
+    assert v0 == constants.VALIDATOR_REWARD_YEAR1
+    assert s0 == constants.SMINER_REWARD_YEAR1
+    assert v1 == v0 * 841 // 1000
+    assert Staking.rewards_in_year(30) == (0, 0)
+
+
+def test_scheduler_credit_scoring(rt):
+    rt.credit.record_proceed_block_size("tee1", 700)
+    rt.credit.record_proceed_block_size("tee2", 300)
+    rt.credit.record_punishment("tee2")
+    rt.credit._rollover()
+    credits = rt.credit.credits()
+    assert credits["tee1"] == 700 * 50 // 100  # 700/1000*1000 * 50%
+    assert credits["tee2"] == max(0, 300 - 100) * 50 // 100
+
+
+def test_cacher_pay_and_replay_protection(rt):
+    from cess_tpu.chain.cacher import Bill
+
+    rt.fund("cch", 100 * D)
+    rt.apply_extrinsic("cch", "cacher.register", "cch_payee", b"peer", 1)
+    bill = Bill(id=b"b1", to="cch", amount=5 * D)
+    rt.apply_extrinsic(ALICE, "cacher.pay", [bill])
+    assert rt.balances.free("cch_payee") == 5 * D
+    with pytest.raises(DispatchError, match="BillReplayed"):
+        rt.apply_extrinsic(ALICE, "cacher.pay", [bill])
+
+
+def test_extrinsic_rollback_on_error(rt):
+    """A failing extrinsic leaves no state behind (FRAME transactional)."""
+    root0 = rt.state.state_root()
+    with pytest.raises(DispatchError):
+        rt.apply_extrinsic(BOB, "file_bank.upload_declaration", FILE,
+                           seg_hashes(2), UserBrief(BOB, "f", "nobucket"),
+                           2 * 16 * MIB)
+    assert rt.state.state_root() == root0

@@ -76,6 +76,28 @@ def test_soundness_wrong_sigma_and_replay():
     assert not bool(np.asarray(ok2)[0])
 
 
+def test_hash_derived_fragment_ids():
+    """Hash-pair ids: unique per fragment, full 64-bit fold, batchable."""
+    import jax.numpy as jnp
+
+    key = podr2.Podr2Key.generate(3)
+    frags = make_fragments(2, seed=8)
+    h1, h2 = b"\xaa" * 32, (b"\xbb" * 8 + b"\xaa" * 24)
+    ids = jnp.asarray(np.stack([podr2.fragment_id_from_hash(h1),
+                                podr2.fragment_id_from_hash(h2)]))
+    tags = podr2.tag_fragments(key, ids, frags)
+    blocks = tags.shape[1]
+    idx, nu = podr2.gen_challenge(b"hash-id-round", blocks)
+    mu, sigma = podr2.prove_batch(jnp.asarray(frags), tags, idx, nu)
+    ok = podr2.verify_batch(key, ids, blocks, idx, nu, mu, sigma)
+    assert bool(np.all(np.asarray(ok)))
+    # ids differing only in the HIGH word must produce different tags
+    h3 = b"\xaa" * 4 + b"\xcc" * 4 + b"\xaa" * 24
+    id3 = jnp.asarray(podr2.fragment_id_from_hash(h3)[None])
+    tags3 = podr2.tag_fragments(key, id3, frags[:1])
+    assert not np.array_equal(np.asarray(tags[:1]), np.asarray(tags3))
+
+
 def test_proof_size_within_chain_cap():
     from cess_tpu.constants import SIGMA_MAX
 
@@ -89,7 +111,7 @@ def test_tag_oracle_parity_numpy_bigint():
     tags = np.asarray(podr2.tag_fragment(key, 0, frag))
     alpha = np.asarray(key.alpha)
     m = np.asarray(podr2.fragment_to_elems(jnp.asarray(frag)))
-    f = np.asarray(podr2._prf_elems(key.prf_key, 0, m.shape[0]))
+    f = np.asarray(podr2.prf_elems(key.prf_key, 0, m.shape[0]))
     for b in range(m.shape[0]):
         want = (int(f[b]) + sum(int(a) * int(x) for a, x in zip(alpha, m[b]))) % pf.P
         assert int(tags[b]) == want
