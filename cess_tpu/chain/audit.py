@@ -67,12 +67,16 @@ class ProveInfo:
 
 class Audit:
     def __init__(self, state: State, sminer: Sminer, tee_worker=None,
-                 storage_handler=None, file_bank=None):
+                 storage_handler=None, file_bank=None,
+                 challenge_life: int = CHALLENGE_LIFE_BASE,
+                 verify_life: int = VERIFY_LIFE):
         self.state = state
         self.sminer = sminer
         self.tee_worker = tee_worker        # runtime wiring
         self.storage_handler = storage_handler
         self.file_bank = file_bank
+        self.challenge_life = challenge_life
+        self.verify_life = verify_life
 
     # -- session keys -------------------------------------------------------
     def set_keys(self, validators: tuple[str, ...]) -> None:
@@ -136,11 +140,11 @@ class Audit:
         self.state.put(PALLET, "voted", validator, digest)
         if count * 3 >= len(keys) * 2 and count > 0:
             now = self.state.block
-            life = CHALLENGE_LIFE_BASE + CHALLENGE_LIFE_PER_MINER * len(miners)
+            life = self.challenge_life + CHALLENGE_LIFE_PER_MINER * len(miners)
             self.state.put(PALLET, "challenge", ChallengeInfo(
                 net=net, miners=miners, start=now,
                 challenge_deadline=now + life,
-                verify_deadline=now + life + VERIFY_LIFE))
+                verify_deadline=now + life + self.verify_life))
             for (k,), _ in list(self.state.iter_prefix(PALLET, "proposal")):
                 self.state.delete(PALLET, "proposal", k)
             for (k,), _ in list(self.state.iter_prefix(PALLET, "voted")):
@@ -288,7 +292,7 @@ class Audit:
             self.state.put(PALLET, "unverify", target, cur + (mission,))
         self.state.put(PALLET, "verify_extended", True)
         self.state.put(PALLET, "challenge", dataclasses.replace(
-            ch, verify_deadline=ch.verify_deadline + VERIFY_LIFE))
+            ch, verify_deadline=ch.verify_deadline + self.verify_life))
         self.state.deposit_event(PALLET, "VerifyReassigned",
                                  missions=len(all_missions))
         return True

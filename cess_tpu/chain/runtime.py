@@ -49,6 +49,8 @@ class RuntimeConfig:
     fragment_count: int = constants.FRAGMENT_COUNT
     era_blocks: int = constants.EPOCH_DURATION_BLOCKS * constants.SESSIONS_PER_ERA
     credit_period_blocks: int | None = None  # default: era_blocks
+    audit_challenge_life: int | None = None  # default: audit module constant
+    audit_verify_life: int | None = None
 
 
 class Runtime:
@@ -70,9 +72,16 @@ class Runtime:
                                   self.sminer, self.scheduler,
                                   fragment_count=self.config.fragment_count,
                                   oss=self.oss)
-        self.audit = Audit(s, self.sminer, tee_worker=self.tee_worker,
-                           storage_handler=self.storage_handler,
-                           file_bank=self.file_bank)
+        # pass only explicitly configured lifetimes; Audit owns defaults
+        audit_overrides = {
+            k: v for k, v in {
+                "challenge_life": self.config.audit_challenge_life,
+                "verify_life": self.config.audit_verify_life,
+            }.items() if v is not None}
+        self.audit = Audit(
+            s, self.sminer, tee_worker=self.tee_worker,
+            storage_handler=self.storage_handler, file_bank=self.file_bank,
+            **audit_overrides)
         self.pallets = {
             "balances": self.balances,
             "storage_handler": self.storage_handler,
@@ -127,12 +136,17 @@ class Runtime:
         hash chain (reference ParentBlockRandomness)."""
         self.state.put("system", "randomness", randomness)
 
-    def init_block(self) -> None:
+    def init_block(self, randomness: bytes | None = None) -> None:
         """Advance one block and run on_initialize hooks in the
-        reference's construct_runtime order (§3.4)."""
+        reference's construct_runtime order (§3.4). ``randomness``
+        comes from consensus (the parent VRF output); without it a
+        deterministic hash chain stands in."""
         self.state.archive_events()
         self.state.block += 1
-        self._update_randomness()
+        if randomness is not None:
+            self.set_randomness(randomness)
+        else:
+            self._update_randomness()
         self.audit.on_initialize()
         dead = self.storage_handler.on_initialize()
         self.file_bank.on_initialize(dead)

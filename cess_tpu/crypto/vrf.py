@@ -1,0 +1,39 @@
+"""Verifiable random function from deterministic Ed25519 signatures.
+
+The reference's RRSC consensus claims slots with sr25519 VRFs
+(schnorrkel, external crate; SURVEY.md §2.3 forked-Substrate row).
+Here: Ed25519 signatures are deterministic, so
+``output = sha256(sign(input))`` is a VRF — unpredictable without the
+secret key, verifiable by anyone with the public key, and unique per
+(key, input) because RFC 8032 signatures are deterministic and the
+verifier checks the signature before trusting the output.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from . import ed25519
+
+
+@dataclasses.dataclass(frozen=True)
+class VrfProof:
+    output: bytes      # 32 bytes, uniform
+    signature: bytes   # 64-byte proof
+
+
+def vrf_sign(key: ed25519.SigningKey, data: bytes) -> VrfProof:
+    sig = key.sign(b"cess-vrf:" + data)
+    return VrfProof(output=hashlib.sha256(sig).digest(), signature=sig)
+
+
+def vrf_verify(public: bytes, data: bytes, proof: VrfProof) -> bool:
+    if not ed25519.verify(public, b"cess-vrf:" + data, proof.signature):
+        return False
+    return hashlib.sha256(proof.signature).digest() == proof.output
+
+
+def output_below(output: bytes, threshold_num: int, threshold_den: int) -> bool:
+    """Slot lottery check: uniform output < c fraction of 2^128."""
+    v = int.from_bytes(output[:16], "little")
+    return v * threshold_den < (1 << 128) * threshold_num

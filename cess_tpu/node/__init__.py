@@ -1,0 +1,11 @@
+"""Node layer: consensus, block production/import, offchain ecosystem.
+
+Mirrors the reference's L4/L5/L6 (SURVEY.md §1): RRSC-style VRF slot
+lottery with epoch randomness and credit-weighted validator election
+(consensus.py), a block production/import/finality harness over the
+chain runtime (network.py), the validator offchain audit worker plus
+the OSS-gateway / storage-miner / TEE-worker agents the reference
+delegates to external repos (offchain.py) — here they drive the TPU
+data plane (cess_tpu.models.pipeline) directly — and a JSON-RPC
+surface (rpc.py) with chain-spec genesis config (chain_spec.py).
+"""

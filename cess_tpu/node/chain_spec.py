@@ -1,0 +1,79 @@
+"""Chain specifications: genesis config for dev/local/test networks.
+
+Reference: node/src/chain_spec.rs (dev/local/testnet/mainnet builders
+plus baked raw specs, :84,210,318-434). A spec fully determines
+genesis state, so every node starting from the same spec reaches the
+same state root — the reproducible-genesis property the reference gets
+from baked JSON specs.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .. import constants
+from ..chain.runtime import Runtime, RuntimeConfig
+from ..crypto import ed25519
+
+D = constants.DOLLARS
+
+
+@dataclasses.dataclass(frozen=True)
+class ValidatorGenesis:
+    account: str
+    bond: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ChainSpec:
+    name: str
+    chain_id: str
+    endowed: tuple[tuple[str, int], ...]
+    validators: tuple[ValidatorGenesis, ...]
+    era_blocks: int = constants.EPOCH_DURATION_BLOCKS * constants.SESSIONS_PER_ERA
+    epoch_blocks: int = constants.EPOCH_DURATION_BLOCKS
+    fragment_count: int = constants.FRAGMENT_COUNT
+    max_validators: int = 100
+    audit_challenge_life: int | None = None   # None -> audit defaults
+    audit_verify_life: int | None = None
+
+    def session_key(self, account: str) -> ed25519.SigningKey:
+        """Deterministic dev session keys derived from the spec id —
+        the analog of //Alice-style dev seeds. Production nodes load
+        keys from their keystore instead."""
+        return ed25519.SigningKey.generate(
+            f"{self.chain_id}:{account}".encode())
+
+    def build_runtime(self) -> Runtime:
+        rt = Runtime(RuntimeConfig(
+            fragment_count=self.fragment_count, era_blocks=self.era_blocks,
+            audit_challenge_life=self.audit_challenge_life,
+            audit_verify_life=self.audit_verify_life))
+        for who, amount in self.endowed:
+            rt.fund(who, amount)
+        for v in self.validators:
+            rt.fund(v.account, v.bond + 100 * D)
+            rt.apply_extrinsic(v.account, "staking.bond", v.bond)
+            rt.apply_extrinsic(v.account, "staking.validate")
+        rt.audit.set_keys(tuple(v.account for v in self.validators))
+        return rt
+
+
+def dev_spec(era_blocks: int = 60, epoch_blocks: int = 20) -> ChainSpec:
+    """Single-authority dev chain (the reference's --dev)."""
+    return ChainSpec(
+        name="cess-tpu dev", chain_id="dev",
+        endowed=(("alice", 1_000_000_000 * D), ("bob", 1_000_000_000 * D)),
+        validators=(ValidatorGenesis("alice", 4_000_000 * D),),
+        era_blocks=era_blocks, epoch_blocks=epoch_blocks)
+
+
+def local_spec(n_validators: int = 4, era_blocks: int = 120,
+               epoch_blocks: int = 30) -> ChainSpec:
+    """Multi-authority local testnet (the reference's local_testnet)."""
+    vals = tuple(ValidatorGenesis(f"val{i}", 4_000_000 * D)
+                 for i in range(n_validators))
+    endowed = tuple((f"user{i}", 100_000_000 * D) for i in range(4)) \
+        + (("faucet", 10_000_000_000 * D),)
+    return ChainSpec(name="cess-tpu local", chain_id="local",
+                     endowed=endowed, validators=vals,
+                     era_blocks=era_blocks, epoch_blocks=epoch_blocks)

@@ -1,0 +1,81 @@
+"""Node CLI (reference: node/src/cli.rs + command.rs).
+
+  python -m cess_tpu.node.cli --dev --blocks 20 --rpc-port 9944
+  python -m cess_tpu.node.cli --chain local --validators 4 --blocks 50
+  python -m cess_tpu.node.cli build-spec --chain dev
+  python -m cess_tpu.node.cli key --suri my-seed
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+from ..crypto import ed25519
+from .chain_spec import dev_spec, local_spec
+from .network import Network, Node
+from .rpc import RpcServer, _encode
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cess-tpu-node")
+    ap.add_argument("subcommand", nargs="?", default="run",
+                    choices=["run", "build-spec", "key"])
+    ap.add_argument("--dev", action="store_true",
+                    help="single-authority dev chain")
+    ap.add_argument("--chain", default="dev", choices=["dev", "local"])
+    ap.add_argument("--validators", type=int, default=4)
+    ap.add_argument("--blocks", type=int, default=0,
+                    help="produce N blocks then exit (0 = run forever)")
+    ap.add_argument("--block-time", type=float, default=0.0,
+                    help="seconds between slots (0 = as fast as possible)")
+    ap.add_argument("--rpc-port", type=int, default=0,
+                    help="serve JSON-RPC on this port (0 = off)")
+    ap.add_argument("--suri", default="dev-seed", help="key seed material")
+    args = ap.parse_args(argv)
+
+    if args.subcommand == "key":
+        key = ed25519.SigningKey.generate(args.suri.encode())
+        print(json.dumps({"public": "0x" + key.public.hex(),
+                          "seed": "0x" + key.seed.hex()}))
+        return 0
+
+    spec = dev_spec() if (args.dev or args.chain == "dev") \
+        else local_spec(args.validators)
+    if args.subcommand == "build-spec":
+        print(json.dumps(_encode(dataclasses.asdict(spec)), indent=2))
+        return 0
+
+    nodes = [Node(spec, f"node-{v.account}",
+                  {v.account: spec.session_key(v.account)})
+             for v in spec.validators]
+    net = Network(nodes)
+    rpc = None
+    if args.rpc_port:
+        rpc = RpcServer(nodes[0], port=args.rpc_port).start()
+        print(f"JSON-RPC on 127.0.0.1:{rpc.port}", file=sys.stderr)
+    produced = 0
+    slot = 1
+    try:
+        while args.blocks == 0 or produced < args.blocks:
+            if net.run_slot(slot) is not None:
+                produced += 1
+                head = nodes[0].chain[-1]
+                print(f"#{head.number} author={head.author} "
+                      f"state={head.state_root.hex()[:16]} "
+                      f"finalized=#{nodes[0].finalized}", file=sys.stderr)
+            slot += 1
+            if args.block_time:
+                time.sleep(args.block_time)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if rpc:
+            rpc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

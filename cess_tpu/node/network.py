@@ -1,0 +1,233 @@
+"""Block production, import, fork choice and finality (in-process net).
+
+The reference's node assembles libp2p gossip + RRSC authoring + GRANDPA
+voting (SURVEY.md §3.1, §3.4); multi-node behavior is only exercised on
+live testnets. Here the same roles run as an in-process network
+harness: every Node owns a full Runtime replica, authors blocks when
+its keys win the slot lottery, imports and RE-EXECUTES peers' blocks
+verifying the VRF claim and state root (state-machine replication), and
+finalizes with 2/3 vote counting (GRANDPA's role, round-simplified).
+
+This doubles as the determinism test rig the reference lacks in-repo:
+any divergence between replicas surfaces as a state-root mismatch at
+import.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from ..chain.state import DispatchError
+from .chain_spec import ChainSpec
+from .consensus import Rrsc, SlotClaim, elect_validators
+
+
+@dataclasses.dataclass(frozen=True)
+class Header:
+    number: int
+    parent: bytes
+    state_root: bytes
+    author: str
+    claim: SlotClaim | None    # None only for genesis
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(repr(self).encode()).digest()
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    header: Header
+    extrinsics: tuple  # ((origin, call, args, kwargs), ...)
+
+
+class Node:
+    def __init__(self, spec: ChainSpec, name: str,
+                 keystore: dict[str, object] | None = None):
+        self.spec = spec
+        self.name = name
+        # dev keystore: session keys for the accounts this node runs
+        self.keystore = keystore if keystore is not None else {}
+        self.runtime = spec.build_runtime()
+        self.rrsc = Rrsc(spec.epoch_blocks)
+        self.authorities = tuple(v.account for v in spec.validators)
+        genesis = Header(number=0, parent=b"\0" * 32,
+                         state_root=self.runtime.state.state_root(),
+                         author="", claim=None)
+        self.chain: list[Header] = [genesis]
+        self.tx_pool: list[tuple] = []
+        self.offchain_agents: list = []
+        self.finalized: int = 0
+        self._proposal: tuple | None = None
+
+    # -- tx pool ---------------------------------------------------------------
+    def submit_extrinsic(self, origin: str, call: str, *args, **kwargs) -> None:
+        self.tx_pool.append((origin, call, args, kwargs))
+
+    # -- authoring ---------------------------------------------------------------
+    def try_author(self, slot: int,
+                   extrinsics: tuple | None = None) -> Block | None:
+        """Claim the slot with any local authority key and build a block
+        as an OPEN PROPOSAL — the caller must commit_proposal() or
+        abort_proposal() (fork choice may prefer a peer's block).
+
+        ``extrinsics``: the tx set to include (the Network hands every
+        proposer the same gossip snapshot); standalone nodes default to
+        draining their own pool."""
+        assert self._proposal is None, "previous proposal not resolved"
+        for account, key in self.keystore.items():
+            if account not in self.authorities:
+                continue
+            claim = self.rrsc.claim_slot(slot, account, key, self.authorities)
+            if claim is None:
+                continue
+            if extrinsics is None:
+                extrinsics = tuple(self.tx_pool)
+                self.tx_pool.clear()
+            snapshot = (self.runtime.state.block,
+                        len(self.runtime.state.event_history),
+                        list(self.runtime.state.events))
+            self.runtime.state.begin_tx()
+            self._execute(claim, extrinsics)
+            header = Header(number=len(self.chain),
+                            parent=self.chain[-1].hash(),
+                            state_root=self.runtime.state.state_root(),
+                            author=account, claim=claim)
+            self._proposal = (header, extrinsics, snapshot)
+            return Block(header=header, extrinsics=extrinsics)
+        return None
+
+    def commit_proposal(self) -> None:
+        header, _, _ = self._proposal
+        self.runtime.state.commit_tx()
+        self._proposal = None
+        self.chain.append(header)
+        self._post_block(header.claim)
+
+    def abort_proposal(self, requeue: bool = True) -> None:
+        """Fork choice lost: roll the whole block back; re-queue txs
+        unless the caller owns tx distribution (Network does)."""
+        _, extrinsics, (block0, hist0, events0) = self._proposal
+        self.runtime.state.rollback_tx()
+        self.runtime.state.block = block0
+        del self.runtime.state.event_history[hist0:]
+        self.runtime.state.events[:] = events0
+        self._proposal = None
+        if requeue:
+            self.tx_pool[:0] = list(extrinsics)
+
+    def _execute(self, claim: SlotClaim, extrinsics: tuple) -> None:
+        self.runtime.init_block(self.rrsc.block_randomness(claim))
+        for origin, call, args, kwargs in extrinsics:
+            try:
+                self.runtime.apply_extrinsic(origin, call, *args, **kwargs)
+            except DispatchError as e:
+                self.runtime.state.deposit_event(
+                    "system", "ExtrinsicFailed", call=call, error=e.name)
+
+    def _post_block(self, claim: SlotClaim) -> None:
+        if claim.vrf is not None:
+            self.rrsc.note_vrf(claim.slot, claim.vrf.output)
+        self._maybe_rotate_session()
+        for agent in self.offchain_agents:
+            agent.on_block(self)
+
+    def _maybe_rotate_session(self) -> None:
+        """Era boundary: credit-weighted election refreshes the
+        authority set (reference §3.5)."""
+        if self.runtime.state.block % self.spec.era_blocks:
+            return
+        stakes = {v: self.runtime.staking.bonded(v)
+                  for v in self.runtime.staking.validators()}
+        credits = self.runtime.credit.credits()
+        elected = elect_validators(stakes, credits, self.spec.max_validators)
+        if elected:
+            self.authorities = elected
+
+    # -- import -------------------------------------------------------------------
+    def import_block(self, block: Block) -> None:
+        """Verify the claim, re-execute, check the state root."""
+        header = block.header
+        if header.number != len(self.chain):
+            raise ValueError(f"{self.name}: non-sequential import "
+                             f"{header.number} != {len(self.chain)}")
+        if header.parent != self.chain[-1].hash():
+            raise ValueError(f"{self.name}: parent hash mismatch")
+        public = self.spec.session_key(header.author).public
+        if not self.rrsc.verify_claim(header.claim, public, self.authorities):
+            raise ValueError(f"{self.name}: bad slot claim")
+        self._execute(header.claim, block.extrinsics)
+        got = self.runtime.state.state_root()
+        if got != header.state_root:
+            raise ValueError(
+                f"{self.name}: state root mismatch at #{header.number} — "
+                "replicas diverged")
+        self.chain.append(header)
+        self._post_block(header.claim)
+
+
+class Network:
+    """Drives slots across nodes: fork choice (primary beats secondary,
+    lowest VRF output wins ties), broadcast, 2/3 finality votes."""
+
+    def __init__(self, nodes: list[Node]):
+        self.nodes = nodes
+        # tx gossip: one shared mempool (instant propagation)
+        shared: list[tuple] = []
+        for node in nodes:
+            shared.extend(node.tx_pool)
+            node.tx_pool = shared
+
+    def run_slot(self, slot: int) -> Block | None:
+        """Authors race; fork choice = primary beats secondary, then
+        lowest VRF output; losers roll back and re-import the winner."""
+        txs = tuple(self.nodes[0].tx_pool)   # one gossip snapshot for all
+        candidates: list[tuple[int, bytes, Node, Block]] = []
+        for node in self.nodes:
+            blk = node.try_author(slot, extrinsics=txs)
+            if blk is not None:
+                claim = blk.header.claim
+                prio = 0 if claim.vrf is not None else 1
+                tiebreak = claim.vrf.output if claim.vrf else b"\xff" * 32
+                candidates.append((prio, tiebreak, node, blk))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        _, _, author_node, best = candidates[0]
+        for _, _, loser, _ in candidates[1:]:
+            loser.abort_proposal(requeue=False)
+        author_node.commit_proposal()
+        for node in self.nodes:
+            if node is not author_node:
+                node.import_block(best)
+        # drop included txs from the shared pool (agents may have added
+        # new ones during _post_block, which stay queued)
+        pool = self.nodes[0].tx_pool
+        for tx in best.extrinsics:
+            try:
+                pool.remove(tx)
+            except ValueError:
+                pass
+        self._finalize(best.header)
+        return best
+
+    def _finalize(self, header: Header) -> None:
+        """GRANDPA-lite: every authority on every node votes for the
+        imported head; 2/3 finalizes."""
+        votes = set()
+        for node in self.nodes:
+            for account in node.keystore:
+                if account in node.authorities:
+                    votes.add(account)
+        n_auth = len(self.nodes[0].authorities)
+        if 3 * len(votes) >= 2 * n_auth:
+            for node in self.nodes:
+                node.finalized = header.number
+
+    def run_slots(self, count: int) -> None:
+        start = max(len(n.chain) for n in self.nodes)
+        produced = 0
+        slot = start
+        while produced < count:
+            if self.run_slot(slot) is not None:
+                produced += 1
+            slot += 1

@@ -1,0 +1,125 @@
+"""JSON-RPC surface over a Node (reference: node/src/rpc.rs).
+
+The reference exposes System/Chain/State/Author (+ Eth namespaces) over
+jsonrpsee; here a threaded stdlib HTTP server speaks JSON-RPC 2.0 with
+the equivalent core namespaces. Bytes are hex-encoded with an "0x"
+prefix; structured extrinsic args are JSON (the wire codec of this
+framework — the reference uses SCALE).
+
+Methods:
+  system_chain, system_health, system_properties
+  chain_getHeader [number?], chain_getFinalizedHead, chain_getBlockNumber
+  state_getStorage [pallet, item, key-parts...], state_getEvents [pallet?]
+  author_submitExtrinsic [origin, call, args...]
+  cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .network import Node
+
+
+def _encode(obj):
+    if isinstance(obj, bytes):
+        return "0x" + obj.hex()
+    if isinstance(obj, (list, tuple)):
+        return [_encode(o) for o in obj]
+    if isinstance(obj, frozenset):
+        return sorted(_encode(o) for o in obj)
+    if isinstance(obj, dict):
+        return {str(k): _encode(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _encode(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    return obj
+
+
+def _decode(obj):
+    if isinstance(obj, str) and obj.startswith("0x"):
+        return bytes.fromhex(obj[2:])
+    if isinstance(obj, list):
+        return [_decode(o) for o in obj]
+    return obj
+
+
+class RpcServer:
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9944):
+        self.node = node
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length))
+                    result = server.handle(req.get("method", ""),
+                                           req.get("params", []))
+                    body = {"jsonrpc": "2.0", "id": req.get("id"),
+                            "result": _encode(result)}
+                except Exception as e:  # JSON-RPC error envelope
+                    body = {"jsonrpc": "2.0", "id": None,
+                            "error": {"code": -32000, "message": str(e)}}
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "RpcServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+
+    # -- method dispatch ------------------------------------------------------
+    def handle(self, method: str, params: list):
+        node = self.node
+        rt = node.runtime
+        if method == "system_chain":
+            return node.spec.name
+        if method == "system_health":
+            return {"peers": 0, "isSyncing": False,
+                    "shouldHavePeers": False}
+        if method == "system_properties":
+            return {"chainId": node.spec.chain_id,
+                    "fragmentCount": node.spec.fragment_count}
+        if method == "chain_getBlockNumber":
+            return rt.state.block
+        if method == "chain_getFinalizedHead":
+            return node.finalized
+        if method == "chain_getHeader":
+            n = params[0] if params else len(node.chain) - 1
+            return node.chain[n]
+        if method == "state_getStorage":
+            key = tuple(_decode(p) for p in params)
+            return rt.state.get(*key)
+        if method == "state_getEvents":
+            pallet = params[0] if params else None
+            events = rt.state.events if pallet is None \
+                else rt.state.events_of(pallet)
+            return events[-100:]
+        if method == "author_submitExtrinsic":
+            origin, call, *args = params
+            node.submit_extrinsic(origin, call, *[_decode(a) for a in args])
+            return True
+        if method == "cess_minerInfo":
+            return rt.sminer.miner(params[0])
+        if method == "cess_fileInfo":
+            return rt.file_bank.file(_decode(params[0]))
+        if method == "cess_challenge":
+            return rt.audit.challenge()
+        raise ValueError(f"unknown method {method!r}")

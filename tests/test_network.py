@@ -1,0 +1,188 @@
+"""Full-network integration: consensus + chain + off-chain agents + TPU
+data plane, multi-replica determinism, audit liveness, data-loss repair.
+
+This is the multi-node behavior the reference never tests in-repo
+(SURVEY.md §4: "Multi-node behavior is NOT tested... exercised only on
+live dev/testnets").
+"""
+import numpy as np
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain.file_bank import UserBrief
+from cess_tpu.crypto.hashing import fragment_hash
+from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis, dev_spec, local_spec
+from cess_tpu.node.network import Network, Node
+from cess_tpu.node.offchain import MinerAgent, OssGateway, TeeAgent, ValidatorOcw
+from cess_tpu.ops import podr2
+
+D = constants.DOLLARS
+
+
+def make_net(n_validators=3):
+    spec = ChainSpec(
+        name="t", chain_id="test-net",
+        endowed=(("alice", 1_000_000_000 * D), ("gw", 1_000_000 * D),
+                 ("stash1", 10_000_000 * D),
+                 ("m1", 10_000 * D), ("m2", 10_000 * D), ("m3", 10_000 * D),
+                 ("m4", 10_000 * D)),
+        validators=tuple(ValidatorGenesis(f"v{i}", 4_000_000 * D)
+                         for i in range(n_validators)),
+        era_blocks=40, epoch_blocks=10,
+        audit_challenge_life=6, audit_verify_life=8)
+    nodes = [Node(spec, f"node{i}", {f"v{i}": spec.session_key(f"v{i}")})
+             for i in range(n_validators)]
+    return spec, nodes
+
+
+def test_block_production_and_replica_determinism():
+    spec, nodes = make_net()
+    net = Network(nodes)
+    nodes[0].submit_extrinsic("alice", "balances.transfer", "bob", 5 * D)
+    net.run_slots(12)
+    heads = [n.chain[-1] for n in nodes]
+    assert all(h.hash() == heads[0].hash() for h in heads)
+    assert all(n.runtime.state.state_root()
+               == nodes[0].runtime.state.state_root() for n in nodes)
+    assert nodes[1].runtime.balances.free("bob") == 5 * D
+    assert nodes[0].finalized == heads[0].number
+    authors = {h.author for n in nodes for h in n.chain[1:]}
+    assert authors  # someone authored
+
+
+def test_import_rejects_tampered_state_root():
+    spec, nodes = make_net(2)
+    net = Network(nodes)
+    net.run_slots(2)
+    blk = None
+    slot = 100
+    while blk is None:
+        blk = nodes[0].try_author(slot)
+        slot += 1
+    nodes[0].commit_proposal()
+    import dataclasses
+
+    bad = dataclasses.replace(blk.header, state_root=b"\0" * 32)
+    with pytest.raises(ValueError, match="state root|claim"):
+        nodes[1].import_block(dataclasses.replace(blk, header=bad))
+
+
+@pytest.fixture(scope="module")
+def storage_net():
+    """A full storage network: 3 validators, gateway, 4 miners, 1 TEE,
+    with the TPU pipeline on tiny segments."""
+    spec, nodes = make_net(3)
+    net = Network(nodes)
+    node = nodes[0]
+    cfg = PipelineConfig(k=2, m=1, segment_size=64 * 1024)
+    key = podr2.Podr2Key.generate(7)
+    pipe = StoragePipeline(cfg, podr2_key=key)
+
+    # genesis-ish setup extrinsics
+    from cess_tpu.crypto.rsa import generate_rsa_keypair
+
+    kp = generate_rsa_keypair(1024, seed=5)
+    for n in nodes:
+        n.runtime.apply_extrinsic("root", "tee_worker.update_whitelist", b"mr")
+        n.runtime.apply_extrinsic("root", "tee_worker.pin_ias_signer", kp.public)
+    payload = b"report:mr:" + b"tee-pk"
+    node.submit_extrinsic("tee1", "tee_worker.register", "stash1", b"tp",
+                          b"tee-pk", payload, kp.sign_pkcs1v15(payload),
+                          kp.public)
+    for w in ("m1", "m2", "m3", "m4"):
+        node.submit_extrinsic(w, "sminer.regnstk", w, b"p" + w.encode(),
+                              2000 * D)
+    net.run_slots(2)
+    for w in ("m1", "m2", "m3", "m4"):
+        node.submit_extrinsic(w, "file_bank.upload_filler", 3000)
+    net.run_slots(2)
+    node.submit_extrinsic("alice", "storage_handler.buy_space", 10)
+    node.submit_extrinsic("alice", "oss.authorize", "gw")
+    net.run_slots(2)
+    node.submit_extrinsic("gw", "file_bank.create_bucket", "alice", "photos")
+    net.run_slots(2)
+
+    gw = OssGateway(node, "gw", pipe)
+    miners = [MinerAgent(node, w, [gw], pipe)
+              for w in ("m1", "m2", "m3", "m4")]
+    tee = TeeAgent(node, "tee1", key, cfg.blocks_per_fragment)
+    # two validators' offchain workers: 2/3 matching proposals activate
+    ocws = [ValidatorOcw("v0"), ValidatorOcw("v1")]
+    node.offchain_agents.extend([*miners, tee, *ocws])
+    # fund the reward pool so audits pay out
+    for n in nodes:
+        n.runtime.fund("sminer_reward_pool", 10_000 * D)
+    return spec, net, node, gw, miners, tee, cfg
+
+
+def test_file_upload_through_network(storage_net):
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    data = np.random.default_rng(0).integers(0, 256, 150_000,
+                                             dtype=np.uint8).tobytes()
+    fh = gw.upload("alice", "photos", "cat.jpg", data)
+    net.run_slots(1)   # declaration lands; deal created
+    assert node.runtime.file_bank.deal(fh) is not None
+    net.run_slots(2)   # miners fetch + report
+    f = node.runtime.file_bank.file(fh)
+    assert f is not None and f.state == "calculate"
+    # the scheduler would fire calculate_end after the 600-block tag
+    # window; drive it now via a root extrinsic through a block
+    node.submit_extrinsic("root", "file_bank.calculate_end", fh)
+    net.run_slots(1)
+    f = node.runtime.file_bank.file(fh)
+    assert f.state == "active"
+    # every assigned miner holds real bytes matching the on-chain hashes
+    for seg in f.segments:
+        for row, h in enumerate(seg.fragment_hashes):
+            holder = next(m for m in miners if m.account == f.miners[row])
+            assert fragment_hash(holder.store[h]) == h
+
+
+def test_audit_round_over_network(storage_net):
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    rt = node.runtime
+    # run until a challenge starts, proofs submitted, verified, ended
+    for _ in range(60):
+        net.run_slots(1)
+        if rt.state.events_of("audit", "VerifyResult"):
+            break
+    results = rt.state.events_of("audit", "VerifyResult")
+    assert results, "audit round never produced verify results"
+    assert all(dict(e.data)["idle"] and dict(e.data)["service"]
+               for e in results), "honest miners must pass"
+    assert rt.state.events_of("sminer", "RewardPaid")
+    # replicas still in lockstep after the full audit machinery
+    assert all(n.runtime.state.state_root()
+               == net.nodes[0].runtime.state.state_root()
+               for n in net.nodes)
+
+
+def test_data_loss_detected_and_repaired(storage_net):
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    rt = node.runtime
+    # find an active file + a victim fragment
+    fh, f = next(((k[0], v) for k, v in
+                  rt.state.iter_prefix("file_bank", "file")
+                  if v.state == "active"))
+    victim_row = 0
+    victim = next(m for m in miners if m.account == f.miners[victim_row])
+    frag = f.segments[0].fragment_hashes[victim_row]
+    del victim.store[frag]          # simulate disk loss
+    del victim.tags[frag]
+    # victim reports the break; a healthy peer repairs via RS decode
+    node.submit_extrinsic(victim.account, "file_bank.generate_restoral_order",
+                          fh, frag)
+    net.run_slots(1)
+    assert rt.file_bank.restoral_order(frag) is not None
+    rescuer = next(m for m in miners if m.account not in f.miners)
+    assert rescuer.try_repair(frag, miners, [gw])
+    net.run_slots(1)
+    assert rt.file_bank.restoral_order(frag) is None
+    assert fragment_hash(rescuer.store[frag]) == frag
+    ev = rt.state.events_of("file_bank", "RestoralComplete")
+    assert ev and dict(ev[-1].data)["miner"] == rescuer.account
+    # replicas agree after the whole repair market dance
+    assert all(n.runtime.state.state_root()
+               == net.nodes[0].runtime.state.state_root()
+               for n in net.nodes)

@@ -1,0 +1,132 @@
+"""Node-layer unit tests: consensus primitives, election, RPC, CLI."""
+import json
+import urllib.request
+
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.crypto import ed25519
+from cess_tpu.crypto.vrf import vrf_sign, vrf_verify
+from cess_tpu.node.chain_spec import dev_spec, local_spec
+from cess_tpu.node.consensus import Rrsc, elect_validators
+from cess_tpu.node.network import Network, Node
+
+D = constants.DOLLARS
+
+
+def test_ed25519_rfc8032_vectors():
+    sk = ed25519.SigningKey(bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"))
+    assert sk.public.hex() == \
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    sig = sk.sign(b"")
+    assert sig.hex().startswith("e5564300c360ac72")
+    assert ed25519.verify(sk.public, b"", sig)
+    assert not ed25519.verify(sk.public, b"tampered", sig)
+    sig2 = bytearray(sig)
+    sig2[0] ^= 1
+    assert not ed25519.verify(sk.public, b"", bytes(sig2))
+
+
+def test_vrf_properties():
+    k1 = ed25519.SigningKey.generate(b"k1")
+    k2 = ed25519.SigningKey.generate(b"k2")
+    p = vrf_sign(k1, b"input")
+    assert vrf_verify(k1.public, b"input", p)
+    assert not vrf_verify(k2.public, b"input", p)
+    assert not vrf_verify(k1.public, b"other", p)
+    assert vrf_sign(k1, b"input").output == p.output  # deterministic
+
+
+def test_rrsc_slot_claims_verify_and_fallback():
+    rrsc = Rrsc(epoch_blocks=10)
+    auths = ("a", "b", "c")
+    keys = {a: ed25519.SigningKey.generate(a.encode()) for a in auths}
+    primaries = secondaries = 0
+    for slot in range(60):
+        claims = [rrsc.claim_slot(slot, a, keys[a], auths) for a in auths]
+        claims = [c for c in claims if c is not None]
+        assert claims, "every slot must have at least the secondary author"
+        for c in claims:
+            assert rrsc.verify_claim(c, keys[c.authority].public, auths)
+            if c.vrf is not None:
+                primaries += 1
+            else:
+                secondaries += 1
+        # an outsider cannot forge a claim
+        outsider = ed25519.SigningKey.generate(b"outsider")
+        assert rrsc.claim_slot(slot, "z", outsider, auths) is None
+    assert primaries > 0 and secondaries > 0
+
+
+def test_rrsc_epoch_randomness_evolves():
+    rrsc = Rrsc(epoch_blocks=5)
+    r0 = rrsc.epoch_randomness(0)
+    rrsc.note_vrf(3, b"vrf-out-1")
+    r1 = rrsc.epoch_randomness(1)
+    assert r0 != r1
+    rrsc2 = Rrsc(epoch_blocks=5)
+    assert rrsc2.epoch_randomness(1) != r1  # vrf outputs fold in
+
+
+def test_credit_weighted_election():
+    stakes = {"a": 5_000_000 * D, "b": 4_000_000 * D,
+              "c": 10_000_000 * D, "poor": 1 * D}
+    credits = {"b": 900, "a": 100}
+    elected = elect_validators(stakes, credits, 2)
+    assert elected == ("b", "a")      # credit beats stake
+    assert "poor" not in elect_validators(stakes, {}, 4)  # stake floor
+
+
+def test_rpc_server_roundtrip():
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "n0", {"alice": spec.session_key("alice")})
+    net = Network([node])
+    net.run_slots(3)
+    rpc = RpcServer(node, port=0).start()
+    try:
+        def call(method, *params):
+            req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{rpc.port}", data=req,
+                    headers={"Content-Type": "application/json"})) as resp:
+                return json.loads(resp.read())
+
+        assert call("system_chain")["result"] == "cess-tpu dev"
+        assert call("chain_getBlockNumber")["result"] == 3
+        hdr = call("chain_getHeader")["result"]
+        assert hdr["number"] == 3 and hdr["state_root"].startswith("0x")
+        assert call("author_submitExtrinsic", "alice", "balances.transfer",
+                    "bob", 7)["result"] is True
+        net.run_slots(1)
+        free = call("state_getStorage", "balances", "free", "bob")["result"]
+        assert free == 1_000_000_000 * D + 7
+        assert "error" in call("nonexistent_method")
+    finally:
+        rpc.stop()
+
+
+def test_cli_smoke(capsys):
+    from cess_tpu.node.cli import main
+
+    assert main(["key", "--suri", "test"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["public"].startswith("0x") and len(out["public"]) == 66
+    assert main(["build-spec", "--chain", "dev"]) == 0
+    spec = json.loads(capsys.readouterr().out)
+    assert spec["chain_id"] == "dev"
+    assert main(["run", "--dev", "--blocks", "3"]) == 0
+
+
+def test_local_spec_multinode_eras_rotate():
+    spec = local_spec(n_validators=3, era_blocks=20, epoch_blocks=10)
+    nodes = [Node(spec, f"n{i}", {f"val{i}": spec.session_key(f"val{i}")})
+             for i in range(3)]
+    net = Network(nodes)
+    net.run_slots(25)   # crosses an era boundary
+    assert all(n.runtime.staking.current_era() >= 1 for n in nodes)
+    assert all(n.runtime.state.state_root()
+               == nodes[0].runtime.state.state_root() for n in nodes)
