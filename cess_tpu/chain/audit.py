@@ -20,17 +20,21 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 
-from .. import constants
+from .. import codec, constants
+from ..crypto import ed25519
 from .sminer import Sminer
 from .state import DispatchError, State
 
 PALLET = "audit"
+
+SESSION_SIGNING_CONTEXT = b"cess-tpu/audit-proposal-v1:"
 
 CHALLENGE_LIFE_BASE = 300      # blocks; + per-miner extension like the ref
 CHALLENGE_LIFE_PER_MINER = 1
 VERIFY_LIFE = constants.BLOCKS_PER_HOUR   # VerifyDuration = +1h (:395-411)
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class NetSnapshot:
     total_reward: int
@@ -40,6 +44,7 @@ class NetSnapshot:
     randoms: tuple[bytes, ...]          # 20-byte randoms per index
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class MinerSnapshot:
     miner: str
@@ -121,25 +126,50 @@ class Audit:
     @staticmethod
     def snapshot_digest(net: NetSnapshot,
                         miners: tuple[MinerSnapshot, ...]) -> bytes:
-        return hashlib.sha256(repr((net, miners)).encode()).digest()
+        return hashlib.sha256(codec.encode((net, miners))).digest()
 
     # -- proposal aggregation (lib.rs:377-425) --------------------------------
     def save_challenge_info(self, validator: str, net: NetSnapshot,
-                            miners: tuple[MinerSnapshot, ...]) -> None:
+                            miners: tuple[MinerSnapshot, ...],
+                            signature: bytes) -> None:
+        """Unsigned-transaction analog: ``signature`` is the session
+        key's ed25519 signature over the snapshot digest, checked
+        against the on-chain session-key registry — the reference's
+        check_unsign/validate_unsigned (lib.rs:595-611,739-772).
+
+        Aggregation counts DISTINCT voters per digest (a frozenset),
+        so a validator alternating votes between digests can never
+        raise any digest's count above one — the vote-switching
+        count-pumping of the round-1 increment scheme is impossible
+        by construction."""
         keys = self.keys()
         if validator not in keys:
             raise DispatchError("audit.NotAuditKey", validator)
+        session_pub = self.state.get("system", "session_key", validator)
+        if session_pub is None:
+            raise DispatchError("audit.NoSessionKey", validator)
+        digest = self.snapshot_digest(net, miners)
+        if not ed25519.verify(session_pub, SESSION_SIGNING_CONTEXT + digest,
+                              signature):
+            raise DispatchError("audit.BadSessionSignature", validator)
         if self.challenge() is not None:
             raise DispatchError("audit.ChallengeInProgress")
-        digest = self.snapshot_digest(net, miners)
-        prev = self.state.get(PALLET, "voted", validator)
-        if prev == digest:
+        now = self.state.block
+        # voters kept as a SORTED tuple: frozenset repr order is
+        # PYTHONHASHSEED-dependent and would poison the state root
+        # across processes
+        voters = self.state.get(PALLET, "proposal", digest,
+                                default=((), now))[0]
+        if validator in voters:
             raise DispatchError("audit.AlreadyProposed")
-        count = self.state.get(PALLET, "proposal", digest, default=0) + 1
-        self.state.put(PALLET, "proposal", digest, count)
-        self.state.put(PALLET, "voted", validator, digest)
-        if count * 3 >= len(keys) * 2 and count > 0:
-            now = self.state.block
+        voters = tuple(sorted((*voters, validator)))
+        self.state.put(PALLET, "proposal", digest, (voters, now))
+        # prune stale proposals so failed rounds don't leak state
+        for (k,), (_, born) in list(self.state.iter_prefix(PALLET,
+                                                           "proposal")):
+            if born + self.challenge_life < now:
+                self.state.delete(PALLET, "proposal", k)
+        if len(voters) * 3 >= len(keys) * 2:
             life = self.challenge_life + CHALLENGE_LIFE_PER_MINER * len(miners)
             self.state.put(PALLET, "challenge", ChallengeInfo(
                 net=net, miners=miners, start=now,
@@ -147,8 +177,6 @@ class Audit:
                 verify_deadline=now + life + self.verify_life))
             for (k,), _ in list(self.state.iter_prefix(PALLET, "proposal")):
                 self.state.delete(PALLET, "proposal", k)
-            for (k,), _ in list(self.state.iter_prefix(PALLET, "voted")):
-                self.state.delete(PALLET, "voted", k)
             self.state.deposit_event(PALLET, "ChallengeStart", start=now,
                                      miners=len(miners))
 

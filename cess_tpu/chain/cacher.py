@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from .. import codec
 from .balances import Balances
 from .state import DispatchError, State
 
@@ -22,6 +23,7 @@ class CacherInfo:
     byte_price: int     # token units per byte
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class Bill:
     id: bytes

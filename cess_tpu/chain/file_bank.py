@@ -24,7 +24,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 
-from .. import constants
+from .. import codec, constants
 from .scheduler import Scheduler
 from .sminer import Sminer
 from .state import DispatchError, State
@@ -44,6 +44,7 @@ class SegmentInfo:
     fragment_hashes: tuple[bytes, ...]   # len == fragment_count
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class UserBrief:
     user: str

@@ -21,6 +21,7 @@ from .. import constants
 from .audit import Audit
 from .balances import Balances
 from .cacher import Cacher
+from .extrinsic import SignedExtrinsic, verify_signature
 from .file_bank import FileBank
 from .oss import Oss
 from .scheduler import Scheduler
@@ -29,9 +30,11 @@ from .sminer import Sminer
 from .staking import Staking
 from .state import DispatchError, State
 from .storage_handler import StorageHandler
+from .system import System
 from .tee_worker import TeeWorker
 
 ROOT = "root"
+TREASURY = "treasury"
 
 # extrinsics only the root / scheduler origin may call
 ROOT_ONLY = {
@@ -41,6 +44,44 @@ ROOT_ONLY = {
     "tee_worker.update_whitelist",
     "tee_worker.pin_ias_signer",
     "audit.set_keys",
+}
+
+# the dispatch surface — FRAME's #[pallet::call] analog. Pallet
+# methods NOT listed here (mint, set_sudo, lock_space, punish hooks,
+# ...) are internal: reachable only through other pallets or hooks,
+# never from a transaction.
+SIGNED_CALLS = {
+    "system.set_session_key", "system.remark",
+    "balances.transfer",
+    "storage_handler.buy_space", "storage_handler.expansion_space",
+    "storage_handler.renewal_space",
+    "sminer.regnstk", "sminer.increase_collateral",
+    "sminer.update_beneficiary", "sminer.update_peer_id",
+    "oss.register", "oss.update", "oss.destroy",
+    "oss.authorize", "oss.cancel_authorize",
+    "cacher.register", "cacher.update", "cacher.logout", "cacher.pay",
+    "staking.bond", "staking.unbond", "staking.validate", "staking.chill",
+    "tee_worker.register", "tee_worker.exit",
+    "file_bank.create_bucket", "file_bank.delete_bucket",
+    "file_bank.upload_declaration", "file_bank.transfer_report",
+    "file_bank.delete_file", "file_bank.ownership_transfer",
+    "file_bank.upload_filler", "file_bank.replace_file_report",
+    "file_bank.generate_restoral_order", "file_bank.claim_restoral_order",
+    "file_bank.restoral_order_complete", "file_bank.miner_exit_prep",
+    "file_bank.miner_withdraw",
+    "audit.save_challenge_info", "audit.submit_proof",
+    "audit.submit_verify_result",
+}
+DISPATCHABLE = SIGNED_CALLS | ROOT_ONLY
+
+# calls exempt from fees: the reference submits these as validated
+# unsigned / operational transactions (audit/src/lib.rs:739-772), so
+# the TEE/miner/validator accounts need no spendable balance to keep
+# the audit loop alive
+FEELESS = {
+    "audit.save_challenge_info",
+    "audit.submit_proof",
+    "audit.submit_verify_result",
 }
 
 
@@ -57,6 +98,7 @@ class Runtime:
     def __init__(self, config: RuntimeConfig | None = None):
         self.config = config or RuntimeConfig()
         s = self.state = State()
+        self.system = System(s)
         self.balances = Balances(s)
         self.storage_handler = StorageHandler(s, self.balances)
         self.sminer = Sminer(s, self.balances, self.storage_handler)
@@ -83,6 +125,7 @@ class Runtime:
             storage_handler=self.storage_handler, file_bank=self.file_bank,
             **audit_overrides)
         self.pallets = {
+            "system": self.system,
             "balances": self.balances,
             "storage_handler": self.storage_handler,
             "sminer": self.sminer,
@@ -99,16 +142,24 @@ class Runtime:
 
     # -- dispatch --------------------------------------------------------------
     def _resolve(self, call: str):
+        if call not in DISPATCHABLE:
+            raise DispatchError("system.UnknownCall", call)
         pallet_name, _, method_name = call.partition(".")
         pallet = self.pallets.get(pallet_name)
         fn = getattr(pallet, method_name, None)
-        if pallet is None or fn is None or method_name.startswith("_"):
+        if pallet is None or fn is None:
             raise DispatchError("system.UnknownCall", call)
         return fn
 
     def apply_extrinsic(self, origin: str, call: str, *args, **kwargs):
-        """Transactional dispatch; rolls back on DispatchError and
-        re-raises (tests assert on error names like assert_noop!)."""
+        """RAW transactional dispatch: rolls back on DispatchError and
+        re-raises (tests assert on error names like assert_noop!).
+
+        This is the mock-runtime entry point — the analog of driving a
+        FRAME pallet with RuntimeOrigin::signed(x) in unit tests. The
+        node/network path never calls it: blocks carry
+        ``SignedExtrinsic``s applied via :meth:`apply_signed`, which
+        authenticates the origin first."""
         fn = self._resolve(call)
         if call in ROOT_ONLY:
             if origin != ROOT:
@@ -125,6 +176,88 @@ class Runtime:
         self.state.commit_tx()
         return result
 
+    # -- signed pipeline (runtime/src/lib.rs:1564-1590) -----------------------
+    def genesis_hash(self) -> bytes:
+        return self.state.get("system", "genesis", default=b"\0" * 32)
+
+    def set_genesis_hash(self, h: bytes) -> None:
+        self.state.put("system", "genesis", h)
+
+    def tx_fee(self, xt: SignedExtrinsic) -> int:
+        """base + per-byte length fee (TransactionPayment's role)."""
+        if xt.call in FEELESS:
+            return 0
+        return constants.TX_BASE_FEE + constants.TX_BYTE_FEE * len(xt)
+
+    @staticmethod
+    def _check_shape(xt: SignedExtrinsic) -> None:
+        """Structural validation of a (possibly peer-decoded)
+        extrinsic: codec.decode constructs dataclasses without field
+        checks, so every field is untrusted until proven well-formed.
+        A self-signed-but-malformed tx must fail with a DispatchError
+        (deterministic skip), never a TypeError mid-block."""
+        ok = (isinstance(xt.signer, str) and xt.signer
+              and isinstance(xt.public, bytes) and len(xt.public) == 32
+              and isinstance(xt.nonce, int) and xt.nonce >= 0
+              and isinstance(xt.call, str)
+              and isinstance(xt.args, tuple)
+              and isinstance(xt.kwargs, tuple)
+              and all(isinstance(kv, tuple) and len(kv) == 2
+                      and isinstance(kv[0], str) for kv in xt.kwargs)
+              and isinstance(xt.signature, bytes)
+              and len(xt.signature) == 64)
+        if not ok:
+            raise DispatchError("system.MalformedTransaction")
+
+    def validate_signed(self, xt: SignedExtrinsic, *,
+                        at_apply: bool = False,
+                        pending_from_signer: int = 0) -> int:
+        """Pre-dispatch validity (the SignedExtra checks): shape,
+        signature over (genesis, nonce, call), account-key binding,
+        sequential nonce, fee affordability. Raises DispatchError when
+        invalid; returns the fee so apply_signed charges what was
+        checked without re-encoding."""
+        if not isinstance(xt, SignedExtrinsic):
+            raise DispatchError("system.NotSigned", str(type(xt).__name__))
+        self._check_shape(xt)
+        if xt.call not in DISPATCHABLE:
+            raise DispatchError("system.UnknownCall", xt.call)
+        if not verify_signature(xt, self.genesis_hash()):
+            raise DispatchError("system.BadSignature", xt.call)
+        bound = self.system.account_key(xt.signer)
+        if bound is not None and bound != xt.public:
+            raise DispatchError("system.AccountKeyMismatch", xt.signer)
+        expected = self.system.nonce(xt.signer) + pending_from_signer
+        if xt.nonce != expected:
+            raise DispatchError(
+                "system.BadNonce", f"{xt.call}: {xt.nonce} != {expected}")
+        fee = self.tx_fee(xt)
+        if self.balances.free(xt.signer) < fee:
+            raise DispatchError("system.CannotPayFee", xt.signer)
+        if at_apply and xt.call in ROOT_ONLY \
+                and xt.signer != self.system.sudo():
+            raise DispatchError("system.BadOrigin", xt.call)
+        return fee
+
+    def apply_signed(self, xt: SignedExtrinsic):
+        """Authenticated dispatch inside block execution. Signature,
+        binding, and nonce are re-verified; the nonce bump, first-use
+        key binding, and fee charge stick even if the call itself
+        fails (frame-system semantics: replay protection and fees are
+        not rolled back with the dispatch)."""
+        fee = self.validate_signed(xt, at_apply=True)
+        self.system.bind_account_key(xt.signer, xt.public)
+        self.system.bump_nonce(xt.signer)
+        if fee:
+            # 80% treasury / 20% block author (runtime/src/lib.rs:190-204)
+            author = self.state.get("system", "author", default="")
+            self.balances.transfer(xt.signer, TREASURY, fee * 8 // 10)
+            self.balances.transfer(xt.signer, author or TREASURY,
+                                   fee - fee * 8 // 10)
+        origin = ROOT if xt.call in ROOT_ONLY else xt.signer
+        return self.apply_extrinsic(origin, xt.call, *xt.args,
+                                    **dict(xt.kwargs))
+
     # -- block execution ---------------------------------------------------------
     def _update_randomness(self) -> None:
         prev = self.state.get("system", "randomness", default=b"genesis")
@@ -136,13 +269,16 @@ class Runtime:
         hash chain (reference ParentBlockRandomness)."""
         self.state.put("system", "randomness", randomness)
 
-    def init_block(self, randomness: bytes | None = None) -> None:
+    def init_block(self, randomness: bytes | None = None,
+                   author: str = "") -> None:
         """Advance one block and run on_initialize hooks in the
         reference's construct_runtime order (§3.4). ``randomness``
         comes from consensus (the parent VRF output); without it a
-        deterministic hash chain stands in."""
+        deterministic hash chain stands in. ``author`` receives the
+        20% fee share."""
         self.state.archive_events()
         self.state.block += 1
+        self.state.put("system", "author", author)
         if randomness is not None:
             self.set_randomness(randomness)
         else:

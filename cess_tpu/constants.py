@@ -87,6 +87,12 @@ MONTH_BLOCKS = 30 * ONE_DAY_BLOCKS
 CREDIT_HISTORY_WEIGHTS = (50, 20, 15, 10, 5)   # percent, most-recent first
 CREDIT_SCORE_SCALE = 1000
 
+# --- transaction fees (TransactionPayment; runtime/src/lib.rs:190-204) ---
+# 80% of fees to treasury, 20% to block author; values are framework
+# choices (the reference derives them from weight benchmarks)
+TX_BASE_FEE = 10 ** 8            # 1e-4 DOLLARS flat per signed extrinsic
+TX_BYTE_FEE = 10 ** 5            # per encoded byte
+
 # --- consensus (RRSC; runtime/src/lib.rs:181-185,240-241) ---
 RRSC_C_NUM = 1                   # VRF threshold c = 1/4
 RRSC_C_DEN = 4

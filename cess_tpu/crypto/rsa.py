@@ -11,6 +11,8 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 
+from .. import codec
+
 # DigestInfo prefixes (DER) for EMSA-PKCS1-v1_5
 _DIGEST_PREFIX = {
     "sha256": bytes.fromhex("3031300d060960864801650304020105000420"),
@@ -19,6 +21,7 @@ _DIGEST_PREFIX = {
 }
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class RsaPublicKey:
     n: int

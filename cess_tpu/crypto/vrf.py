@@ -14,8 +14,10 @@ import dataclasses
 import hashlib
 
 from . import ed25519
+from .. import codec
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class VrfProof:
     output: bytes      # 32 bytes, uniform

@@ -9,6 +9,7 @@ from baked JSON specs.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 
 from .. import constants
 from ..chain.runtime import Runtime, RuntimeConfig
@@ -35,6 +36,7 @@ class ChainSpec:
     max_validators: int = 100
     audit_challenge_life: int | None = None   # None -> audit defaults
     audit_verify_life: int | None = None
+    sudo: str | None = None                    # dev root origin account
 
     def session_key(self, account: str) -> ed25519.SigningKey:
         """Deterministic dev session keys derived from the spec id —
@@ -43,18 +45,40 @@ class ChainSpec:
         return ed25519.SigningKey.generate(
             f"{self.chain_id}:{account}".encode())
 
+    def account_key(self, account: str) -> ed25519.SigningKey:
+        """Deterministic dev ACCOUNT keys (domain-separated from
+        session keys): what extrinsics are signed with. Production
+        accounts bring their own keys; dev chains derive them like
+        //Alice seeds."""
+        return ed25519.SigningKey.generate(
+            f"{self.chain_id}/account:{account}".encode())
+
+    def genesis_hash(self) -> bytes:
+        """Chain identity bound into every signature (replay domain)."""
+        return hashlib.sha256(
+            f"cess-tpu-genesis:{self.chain_id}:{self.name}".encode()).digest()
+
     def build_runtime(self) -> Runtime:
         rt = Runtime(RuntimeConfig(
             fragment_count=self.fragment_count, era_blocks=self.era_blocks,
             audit_challenge_life=self.audit_challenge_life,
             audit_verify_life=self.audit_verify_life))
+        rt.set_genesis_hash(self.genesis_hash())
+        if self.sudo:
+            rt.system.set_sudo(self.sudo)
         for who, amount in self.endowed:
             rt.fund(who, amount)
+            rt.system.bind_account_key(who, self.account_key(who).public)
         for v in self.validators:
             rt.fund(v.account, v.bond + 100 * D)
+            rt.system.bind_account_key(v.account,
+                                       self.account_key(v.account).public)
+            rt.system.set_session_key(v.account,
+                                      self.session_key(v.account).public)
             rt.apply_extrinsic(v.account, "staking.bond", v.bond)
             rt.apply_extrinsic(v.account, "staking.validate")
         rt.audit.set_keys(tuple(v.account for v in self.validators))
+        rt.state.archive_events()
         return rt
 
 
@@ -64,7 +88,7 @@ def dev_spec(era_blocks: int = 60, epoch_blocks: int = 20) -> ChainSpec:
         name="cess-tpu dev", chain_id="dev",
         endowed=(("alice", 1_000_000_000 * D), ("bob", 1_000_000_000 * D)),
         validators=(ValidatorGenesis("alice", 4_000_000 * D),),
-        era_blocks=era_blocks, epoch_blocks=epoch_blocks)
+        era_blocks=era_blocks, epoch_blocks=epoch_blocks, sudo="alice")
 
 
 def local_spec(n_validators: int = 4, era_blocks: int = 120,
@@ -76,4 +100,5 @@ def local_spec(n_validators: int = 4, era_blocks: int = 120,
         + (("faucet", 10_000_000_000 * D),)
     return ChainSpec(name="cess-tpu local", chain_id="local",
                      endowed=endowed, validators=vals,
-                     era_blocks=era_blocks, epoch_blocks=epoch_blocks)
+                     era_blocks=era_blocks, epoch_blocks=epoch_blocks,
+                     sudo="val0")

@@ -17,11 +17,12 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 
-from .. import constants
+from .. import codec, constants
 from ..crypto import ed25519
 from ..crypto.vrf import VrfProof, output_below, vrf_sign, vrf_verify
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class SlotClaim:
     slot: int

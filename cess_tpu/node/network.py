@@ -17,11 +17,14 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 
+from .. import codec
+from ..chain.extrinsic import SignedExtrinsic, sign_extrinsic
 from ..chain.state import DispatchError
 from .chain_spec import ChainSpec
 from .consensus import Rrsc, SlotClaim, elect_validators
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class Header:
     number: int
@@ -34,6 +37,7 @@ class Header:
         return hashlib.sha256(repr(self).encode()).digest()
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class Block:
     header: Header
@@ -54,14 +58,36 @@ class Node:
                          state_root=self.runtime.state.state_root(),
                          author="", claim=None)
         self.chain: list[Header] = [genesis]
-        self.tx_pool: list[tuple] = []
+        self.tx_pool: list[SignedExtrinsic] = []
         self.offchain_agents: list = []
         self.finalized: int = 0
         self._proposal: tuple | None = None
 
     # -- tx pool ---------------------------------------------------------------
     def submit_extrinsic(self, origin: str, call: str, *args, **kwargs) -> None:
-        self.tx_pool.append((origin, call, args, kwargs))
+        """Dev-mode convenience: sign with the spec-derived account key
+        (the //Alice pattern) and submit. ``origin="root"`` signs as
+        the chain's sudo account. Production clients build a
+        SignedExtrinsic themselves and use :meth:`submit_signed`."""
+        if origin == "root":
+            sudo = self.runtime.system.sudo()
+            if sudo is None:
+                raise DispatchError("system.BadOrigin", call)
+            origin = sudo
+        key = self.spec.account_key(origin)
+        nonce = self.runtime.system.nonce(origin) \
+            + sum(1 for xt in self.tx_pool if xt.signer == origin)
+        self.submit_signed(sign_extrinsic(
+            key, self.runtime.genesis_hash(), origin, nonce, call, args,
+            kwargs))
+
+    def submit_signed(self, xt: SignedExtrinsic) -> None:
+        """Pool admission: full SignedExtra validation (signature,
+        binding, sequential nonce, fee affordability) before the tx is
+        gossiped. Raises DispatchError when invalid."""
+        pending = sum(1 for t in self.tx_pool if t.signer == xt.signer)
+        self.runtime.validate_signed(xt, pending_from_signer=pending)
+        self.tx_pool.append(xt)
 
     # -- authoring ---------------------------------------------------------------
     def try_author(self, slot: int,
@@ -116,11 +142,15 @@ class Node:
             self.tx_pool[:0] = list(extrinsics)
 
     def _execute(self, claim: SlotClaim, extrinsics: tuple) -> None:
-        self.runtime.init_block(self.rrsc.block_randomness(claim))
-        for origin, call, args, kwargs in extrinsics:
+        self.runtime.init_block(self.rrsc.block_randomness(claim),
+                                author=claim.authority)
+        for xt in extrinsics:
             try:
-                self.runtime.apply_extrinsic(origin, call, *args, **kwargs)
+                self.runtime.apply_signed(xt)
             except DispatchError as e:
+                # deterministic across replicas: every node skips the
+                # same invalid tx with the same event
+                call = getattr(xt, "call", "<malformed>")
                 self.runtime.state.deposit_event(
                     "system", "ExtrinsicFailed", call=call, error=e.name)
 
@@ -202,11 +232,8 @@ class Network:
         # drop included txs from the shared pool (agents may have added
         # new ones during _post_block, which stay queued)
         pool = self.nodes[0].tx_pool
-        for tx in best.extrinsics:
-            try:
-                pool.remove(tx)
-            except ValueError:
-                pass
+        included = {id(tx) for tx in best.extrinsics}
+        pool[:] = [tx for tx in pool if id(tx) not in included]
         self._finalize(best.header)
         return best
 

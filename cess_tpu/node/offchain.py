@@ -29,7 +29,7 @@ import dataclasses
 import jax.numpy as jnp
 import numpy as np
 
-from .. import constants
+from .. import codec, constants
 from ..chain.file_bank import UserBrief
 from ..chain.state import DispatchError
 from ..crypto.hashing import fragment_hash
@@ -213,6 +213,7 @@ class MinerAgent:
         return True
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class Proof:
     """The opaque proof blob queued for TEE verification (mu, sigma per
@@ -274,13 +275,20 @@ class TeeAgent:
 
 
 class ValidatorOcw:
-    """The audit offchain worker (audit lib.rs:347-369)."""
+    """The audit offchain worker (audit lib.rs:347-369). Holds the
+    validator's session SIGNING key: proposals carry an ed25519
+    signature over the snapshot digest, verified on chain against the
+    session-key registry (the reference's validate_unsigned,
+    lib.rs:739-772)."""
 
-    def __init__(self, account: str):
+    def __init__(self, account: str, session_key):
         self.account = account
+        self.session_key = session_key
         self._proposed_at: int = -1
 
     def on_block(self, node: Node) -> None:
+        from ..chain.audit import SESSION_SIGNING_CONTEXT, Audit
+
         rt = node.runtime
         if self.account not in rt.audit.keys():
             return
@@ -291,6 +299,8 @@ class ValidatorOcw:
         net, miners = rt.audit.generation_challenge()
         if not miners:
             return
+        digest = Audit.snapshot_digest(net, miners)
+        sig = self.session_key.sign(SESSION_SIGNING_CONTEXT + digest)
         node.submit_extrinsic(self.account, "audit.save_challenge_info",
-                              net, miners)
+                              net, miners, sig)
         self._proposed_at = rt.state.block

@@ -10,7 +10,9 @@ Methods:
   system_chain, system_health, system_properties
   chain_getHeader [number?], chain_getFinalizedHead, chain_getBlockNumber
   state_getStorage [pallet, item, key-parts...], state_getEvents [pallet?]
-  author_submitExtrinsic [origin, call, args...]
+  author_submitExtrinsic [origin, call, args...]   (dev-signed)
+  author_submitSignedExtrinsic [hex codec-encoded SignedExtrinsic]
+  system_accountNextIndex [account]
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
 """
 from __future__ import annotations
@@ -113,9 +115,19 @@ class RpcServer:
                 else rt.state.events_of(pallet)
             return events[-100:]
         if method == "author_submitExtrinsic":
+            # dev convenience: server-side signing with spec dev keys
             origin, call, *args = params
             node.submit_extrinsic(origin, call, *[_decode(a) for a in args])
             return True
+        if method == "author_submitSignedExtrinsic":
+            # production path: client-built SignedExtrinsic, codec-encoded hex
+            from .. import codec as _codec
+
+            xt = _codec.decode(_decode(params[0]))
+            node.submit_signed(xt)
+            return True
+        if method == "system_accountNextIndex":
+            return node.runtime.system.nonce(params[0])
         if method == "cess_minerInfo":
             return rt.sminer.miner(params[0])
         if method == "cess_fileInfo":

@@ -239,11 +239,32 @@ def setup_tee(rt, controller="tee1", stash="stash1"):
     return kp
 
 
-def start_challenge(rt, validators=("v1", "v2", "v3")):
+def audit_keys(rt, validators):
+    """Register session keys for a validator set; return signing keys."""
+    from cess_tpu.crypto import ed25519
+
+    keys = {}
+    for v in validators:
+        k = ed25519.SigningKey.generate(b"sess:" + v.encode())
+        rt.system.set_session_key(v, k.public)
+        keys[v] = k
     rt.audit.set_keys(tuple(validators))
+    return keys
+
+
+def sign_proposal(key, net, miners):
+    from cess_tpu.chain.audit import SESSION_SIGNING_CONTEXT, Audit
+
+    return key.sign(SESSION_SIGNING_CONTEXT
+                    + Audit.snapshot_digest(net, miners))
+
+
+def start_challenge(rt, validators=("v1", "v2", "v3")):
+    keys = audit_keys(rt, validators)
     net, miners = rt.audit.generation_challenge()
     for v in validators[:2]:  # 2/3
-        rt.apply_extrinsic(v, "audit.save_challenge_info", net, miners)
+        rt.apply_extrinsic(v, "audit.save_challenge_info", net, miners,
+                           sign_proposal(keys[v], net, miners))
     assert rt.audit.challenge() is not None
     return net, miners
 
@@ -304,13 +325,44 @@ def test_audit_clear_punish_escalation_and_force_exit(rt):
 
 
 def test_audit_proposal_needs_two_thirds(rt):
-    rt.audit.set_keys(("v1", "v2", "v3"))
+    keys = audit_keys(rt, ("v1", "v2", "v3"))
     net, miners = rt.audit.generation_challenge()
-    rt.apply_extrinsic("v1", "audit.save_challenge_info", net, miners)
+    rt.apply_extrinsic("v1", "audit.save_challenge_info", net, miners,
+                       sign_proposal(keys["v1"], net, miners))
     assert rt.audit.challenge() is None
     with pytest.raises(DispatchError, match="NotAuditKey"):
-        rt.apply_extrinsic("vX", "audit.save_challenge_info", net, miners)
-    rt.apply_extrinsic("v2", "audit.save_challenge_info", net, miners)
+        rt.apply_extrinsic("vX", "audit.save_challenge_info", net, miners,
+                           sign_proposal(keys["v1"], net, miners))
+    # a proposal signed with the wrong session key is rejected
+    with pytest.raises(DispatchError, match="BadSessionSignature"):
+        rt.apply_extrinsic("v2", "audit.save_challenge_info", net, miners,
+                           sign_proposal(keys["v1"], net, miners))
+    rt.apply_extrinsic("v2", "audit.save_challenge_info", net, miners,
+                       sign_proposal(keys["v2"], net, miners))
+    assert rt.audit.challenge() is not None
+
+
+def test_audit_vote_switching_cannot_pump_count(rt):
+    """Round-1 VERDICT repro: v0 alternating votes A, B, A on a 3-key
+    set must NOT activate a challenge (one validator alone pumped the
+    increment-based count to 2 before the fix)."""
+    import dataclasses as dc
+
+    keys = audit_keys(rt, ("v0", "v1", "v2"))
+    net_a, miners = rt.audit.generation_challenge()
+    net_b = dc.replace(net_a, total_reward=net_a.total_reward + 1)
+    rt.apply_extrinsic("v0", "audit.save_challenge_info", net_a, miners,
+                       sign_proposal(keys["v0"], net_a, miners))
+    rt.apply_extrinsic("v0", "audit.save_challenge_info", net_b, miners,
+                       sign_proposal(keys["v0"], net_b, miners))
+    with pytest.raises(DispatchError, match="AlreadyProposed"):
+        rt.apply_extrinsic("v0", "audit.save_challenge_info", net_a, miners,
+                           sign_proposal(keys["v0"], net_a, miners))
+    assert rt.audit.challenge() is None, \
+        "a single validator must never activate a challenge"
+    # a second distinct voter on digest A reaches 2/3 legitimately
+    rt.apply_extrinsic("v1", "audit.save_challenge_info", net_a, miners,
+                       sign_proposal(keys["v1"], net_a, miners))
     assert rt.audit.challenge() is not None
 
 
