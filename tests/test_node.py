@@ -29,6 +29,8 @@ def test_ed25519_rfc8032_vectors():
 
 
 def test_vrf_properties():
+    import dataclasses
+
     k1 = ed25519.SigningKey.generate(b"k1")
     k2 = ed25519.SigningKey.generate(b"k2")
     p = vrf_sign(k1, b"input")
@@ -36,6 +38,50 @@ def test_vrf_properties():
     assert not vrf_verify(k2.public, b"input", p)
     assert not vrf_verify(k1.public, b"other", p)
     assert vrf_sign(k1, b"input").output == p.output  # deterministic
+    # tampering any proof component breaks verification
+    for change in (dict(output=b"\x00" * 32), dict(gamma=b"\x01" * 32),
+                   dict(c=b"\x02" * 16), dict(s=b"\x03" * 32)):
+        assert not vrf_verify(k1.public, b"input",
+                              dataclasses.replace(p, **change))
+
+
+def test_vrf_uniqueness_under_nonce_grinding(monkeypatch):
+    """VERDICT #6 done-criterion: a malicious signer grinding the DLEQ
+    nonce gets DIFFERENT valid proofs but always the SAME output —
+    the lottery result is a pure function of (key, input)."""
+    from cess_tpu.crypto import vrf as vrf_mod
+
+    k = ed25519.SigningKey.generate(b"grinder")
+    honest = vrf_sign(k, b"slot-7")
+    outputs = set()
+    for nonce in (12345, 98765, 2**200 + 3):
+        monkeypatch.setattr(vrf_mod, "_derive_nonce",
+                            lambda prefix, h, _n=nonce: _n)
+        ground = vrf_mod.vrf_sign(k, b"slot-7")
+        assert vrf_verify(k.public, b"slot-7", ground)  # valid proof
+        assert (ground.c, ground.s) != (honest.c, honest.s)
+        outputs.add(ground.output)
+    assert outputs == {honest.output}, \
+        "nonce freedom must not change the VRF output"
+
+
+def test_vrf_rejects_small_order_keys():
+    """RFC 9381 key validation: the identity point as a 'public key'
+    yields input-independent outputs — must never verify."""
+    from cess_tpu.crypto.ed25519 import L as _L
+    from cess_tpu.crypto.ed25519 import _compress, _mul
+    from cess_tpu.crypto.vrf import (VrfProof, _challenge, _hash_to_curve,
+                                     _output_from_gamma)
+
+    identity = _compress((0, 1, 1, 0))
+    h_pt = _hash_to_curve(identity, b"slot-9")
+    k = 424242
+    forged = VrfProof(
+        output=_output_from_gamma((0, 1, 1, 0)), gamma=identity,
+        c=_challenge(_compress(h_pt), identity, _compress(_mul(k)),
+                     _compress(_mul(k, h_pt))).to_bytes(16, "little"),
+        s=(k % _L).to_bytes(32, "little"))
+    assert not vrf_verify(identity, b"slot-9", forged)
 
 
 def test_rrsc_slot_claims_verify_and_fallback():
