@@ -47,9 +47,16 @@ class NetSnapshot:
 @codec.register
 @dataclasses.dataclass(frozen=True)
 class MinerSnapshot:
+    """Per-miner challenge snapshot (ref types.rs:9-50). The owed
+    fragment/filler sets are FROZEN here at challenge creation so the
+    miner's proof and the TEE's verification fold over the exact same
+    sets even when deals/restorals land mid-round (exact-set
+    aggregation has no subset tolerance)."""
     miner: str
     idle_space: int
     service_space: int
+    service_frags: tuple = ()     # owed service fragment hashes, sorted
+    fillers: tuple = ()           # owed filler hashes, sorted
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,7 +109,15 @@ class Audit:
             # exited/locked ones leave the audit set (lib.rs:901-988)
             if m.state in ("positive", "frozen") \
                     and (m.idle_space or m.service_space):
-                miners.append(MinerSnapshot(w, m.idle_space, m.service_space))
+                service = tuple(sorted(
+                    k[0] for k, _ in self.state.iter_prefix(
+                        "file_bank", "frag_of_miner", w)))
+                fillers = tuple(sorted(
+                    self.file_bank.filler_hashes(w)
+                    if self.file_bank else ()))
+                miners.append(MinerSnapshot(w, m.idle_space,
+                                            m.service_space, service,
+                                            fillers))
         miners = tuple(miners[:constants.CHALLENGE_MINER_MAX])
         seed = self.state.get("system", "randomness", default=b"")
         n_chunks = constants.CHUNK_COUNT * constants.CHALLENGE_RATE_NUM \
@@ -191,6 +206,12 @@ class Audit:
             raise DispatchError("audit.NoChallenge")
         if self.state.block > ch.challenge_deadline:
             raise DispatchError("audit.ChallengeExpired")
+        # proofs are opaque WIRE BYTES; the SIGMA_MAX cap measures the
+        # actual serialized size (runtime/src/lib.rs:992), not a
+        # self-reported length
+        if not (isinstance(idle_proof, bytes)
+                and isinstance(service_proof, bytes)):
+            raise DispatchError("audit.MalformedProof")
         if len(idle_proof) > constants.SIGMA_MAX \
                 or len(service_proof) > constants.SIGMA_MAX:
             raise DispatchError("audit.ProofTooLarge")

@@ -403,33 +403,93 @@ class FileBank:
                                  new=new_brief.user)
 
     # -- fillers (idle files; lib.rs:798-859) -------------------------------------
-    def upload_filler(self, miner: str, count: int) -> None:
-        """Certified filler upload adds idle space (8 MiB each)."""
-        if count <= 0:
+    # The reference's FillerMap keys (miner, filler_hash) with TEE
+    # attribution and delete_filler. Here a filler's CONTENT is
+    # PRF-derived from (miner, index) (cess_tpu.node.offchain.
+    # filler_bytes); the TEE regenerates it, checks the hash, tags it,
+    # and signs the batch — so idle space only enters the ledger
+    # against TEE-certified, auditable content.
+    #
+    # Known limitation (shared with this reference snapshot's
+    # generated idle files, lib.rs:798-859): publicly-derivable filler
+    # content proves TAG possession, not dedicated disk — a miner can
+    # regenerate challenged fillers on demand. CESS later replaced
+    # this with PoIS; a miner-secret-seeded variant is the upgrade
+    # path here.
+    FILLER_CERT_CONTEXT = b"cess-filler-cert-v1:"
+
+    def filler_hashes(self, miner: str) -> list[bytes]:
+        return [k[0] for k, _ in self.state.iter_prefix(PALLET, "filler",
+                                                        miner)]
+
+    def filler_cert_nonce(self, miner: str) -> int:
+        return self.state.get(PALLET, "filler_cert_nonce", miner, default=0)
+
+    def upload_filler(self, miner: str, hashes: tuple[bytes, ...],
+                      tee: str, tee_sig: bytes) -> None:
+        """TEE-certified filler registration: every filler hash goes
+        into the registry with the certifying TEE recorded; idle space
+        is credited per filler (8 MiB protocol units).
+
+        The cert covers (miner, hashes, cert_nonce) where cert_nonce
+        is the miner's on-chain filler-cert counter — a cert can never
+        be replayed to re-credit idle space after delete_filler /
+        replace_file_report removed the filler."""
+        from ..crypto import ed25519
+
+        if not hashes or len(set(hashes)) != len(hashes):
             raise DispatchError("file_bank.InvalidCount")
         if not self.sminer.is_positive(miner):
             raise DispatchError("sminer.StateNotPositive")
-        self.sminer.add_miner_idle_space(miner,
-                                         count * constants.FRAGMENT_SIZE)
+        tee_registry = self.state.get("tee_worker", "worker", tee)
+        if tee_registry is None:
+            raise DispatchError("file_bank.NonExistentTee", tee)
+        tee_pub = self.state.get("system", "account_key", tee)
+        nonce = self.filler_cert_nonce(miner)
+        payload = self.FILLER_CERT_CONTEXT + codec.encode(
+            (miner, tuple(hashes), nonce))
+        if tee_pub is None or not isinstance(tee_sig, bytes) \
+                or not ed25519.verify(tee_pub, payload, tee_sig):
+            raise DispatchError("file_bank.BadFillerCert", miner)
+        for h in hashes:
+            if self.state.contains(PALLET, "filler", miner, h):
+                raise DispatchError("file_bank.FillerExists", h.hex())
+        for h in hashes:
+            self.state.put(PALLET, "filler", miner, h,
+                           (tee, self.state.block))
+        self.state.put(PALLET, "filler_cert_nonce", miner, nonce + 1)
+        self.sminer.add_miner_idle_space(
+            miner, len(hashes) * constants.FRAGMENT_SIZE)
         self.state.deposit_event(PALLET, "FillerUpload", miner=miner,
-                                 count=count)
+                                 count=len(hashes))
 
-    def replace_file_report(self, miner: str, count: int) -> None:
-        """Miner deletes fillers freed by stored service fragments
-        (lib.rs:731-760)."""
-        pending = self.pending_replacements(miner)
-        if count <= 0 or count > pending:
-            raise DispatchError("file_bank.InvalidCount",
-                                f"{count} > pending {pending}")
-        self.state.put(PALLET, "pending_replace", miner, pending - count)
+    def delete_filler(self, miner: str, filler_hash: bytes) -> None:
+        """Remove one filler from the registry and the idle ledger
+        (lib.rs:798-859 delete_filler)."""
+        if not self.state.contains(PALLET, "filler", miner, filler_hash):
+            raise DispatchError("file_bank.NonExistentFiller")
+        self.state.delete(PALLET, "filler", miner, filler_hash)
         m = self.sminer.miner(miner)
-        space = count * constants.FRAGMENT_SIZE
         if m is not None:
-            # deleted fillers shrink the idle ledger
-            freed = min(m.idle_space, space)
+            freed = min(m.idle_space, constants.FRAGMENT_SIZE)
             self.state.put("sminer", "miner", miner, dataclasses.replace(
                 m, idle_space=m.idle_space - freed))
             self.storage.sub_total_idle_space(freed)
+
+    def replace_file_report(self, miner: str,
+                            filler_hashes: tuple[bytes, ...]) -> None:
+        """Miner deletes specific fillers freed by stored service
+        fragments (lib.rs:731-760): each named filler leaves the
+        registry, so it stops being audited and stops counting as
+        idle space."""
+        pending = self.pending_replacements(miner)
+        count = len(filler_hashes)
+        if count <= 0 or count > pending:
+            raise DispatchError("file_bank.InvalidCount",
+                                f"{count} > pending {pending}")
+        for h in filler_hashes:
+            self.delete_filler(miner, h)    # raises on unknown hash
+        self.state.put(PALLET, "pending_replace", miner, pending - count)
         self.state.deposit_event(PALLET, "ReplaceFiller", miner=miner,
                                  count=count)
 

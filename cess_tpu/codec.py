@@ -186,7 +186,13 @@ def _decode_at(data: bytes, pos: int) -> tuple[Any, int]:
             d, pos = _read_uvarint(data, pos)
             shape.append(d)
         raw, pos = _read_raw(data, pos)
-        arr = np.frombuffer(raw, dtype=np.dtype(dt.decode())).reshape(shape)
+        # untrusted input: dtype strings and shape/byte-count mismatches
+        # must surface as CodecError, not numpy ValueError/TypeError
+        try:
+            arr = np.frombuffer(raw, dtype=np.dtype(dt.decode())) \
+                .reshape(shape)
+        except (ValueError, TypeError) as e:
+            raise CodecError(f"bad ndarray: {e}") from None
         return arr.copy(), pos
     if tag == _DATACLASS:
         raw, pos = _read_raw(data, pos)

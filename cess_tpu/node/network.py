@@ -225,15 +225,18 @@ class Network:
         _, _, author_node, best = candidates[0]
         for _, _, loser, _ in candidates[1:]:
             loser.abort_proposal(requeue=False)
+        # drop included txs from the shared pool BEFORE _post_block
+        # fires the offchain agents: their new submissions compute
+        # nonces as on-chain + pending, and the included txs' nonces
+        # are already consumed on chain — counting them again would
+        # assign too-high nonces that fail at execution (BadNonce)
+        pool = self.nodes[0].tx_pool
+        included = {id(tx) for tx in best.extrinsics}
+        pool[:] = [tx for tx in pool if id(tx) not in included]
         author_node.commit_proposal()
         for node in self.nodes:
             if node is not author_node:
                 node.import_block(best)
-        # drop included txs from the shared pool (agents may have added
-        # new ones during _post_block, which stay queued)
-        pool = self.nodes[0].tx_pool
-        included = {id(tx) for tx in best.extrinsics}
-        pool[:] = [tx for tx in pool if id(tx) not in included]
         self._finalize(best.header)
         return best
 

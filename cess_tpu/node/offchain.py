@@ -25,6 +25,7 @@ fragment transfer channel, like the reference's network boundary.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -34,6 +35,7 @@ from ..chain.file_bank import UserBrief
 from ..chain.state import DispatchError
 from ..crypto.hashing import fragment_hash
 from ..models.pipeline import PipelineConfig, StoragePipeline
+from ..ops import pfield as pf
 from ..ops import podr2
 from .network import Node
 
@@ -85,6 +87,21 @@ class OssGateway:
         return file_hash
 
 
+def filler_bytes(miner: str, index: int, size: int) -> bytes:
+    """Deterministic filler (idle file) content: a SHA-256 counter-mode
+    stream over (miner, index). Anyone — miner, TEE, auditor — can
+    regenerate a filler byte-exactly, which is how the TEE certifies
+    filler hashes before the chain credits idle space (the reference's
+    generated idle files, file-bank/src/lib.rs:798-859)."""
+    out = bytearray()
+    seed = b"cess-filler:" + miner.encode() + index.to_bytes(8, "little")
+    ctr = 0
+    while len(out) < size:
+        out += hashlib.sha256(seed + ctr.to_bytes(8, "little")).digest()
+        ctr += 1
+    return bytes(out[:size])
+
+
 class MinerAgent:
     def __init__(self, node: Node, account: str, gateways: list[OssGateway],
                  pipeline: StoragePipeline):
@@ -94,8 +111,24 @@ class MinerAgent:
         self.pipeline = pipeline
         self.store: dict[bytes, bytes] = {}        # fragment hash -> bytes
         self.tags: dict[bytes, np.ndarray] = {}
+        self.filler_store: dict[bytes, bytes] = {}  # filler hash -> bytes
+        self.filler_tags: dict[bytes, np.ndarray] = {}
         self._reported: set[bytes] = set()
         self._proved_round: int = -1
+
+    # -- fillers -----------------------------------------------------------------
+    def setup_fillers(self, tee: "TeeAgent", count: int) -> None:
+        """Generate ``count`` fillers, have the TEE certify + tag them,
+        and register them on chain (idle space enters the ledger)."""
+        size = self.pipeline.config.fragment_size
+        blobs = [filler_bytes(self.account, i, size) for i in range(count)]
+        hashes, tags, sig = tee.certify_fillers(self.account,
+                                                list(range(count)), blobs)
+        for h, blob, tag in zip(hashes, blobs, tags):
+            self.filler_store[h] = blob
+            self.filler_tags[h] = tag
+        self.node.submit_extrinsic(self.account, "file_bank.upload_filler",
+                                   tuple(hashes), tee.controller, sig)
 
     # -- deal servicing ---------------------------------------------------------
     def _fetch(self, frag_hash: bytes) -> bool:
@@ -132,30 +165,18 @@ class MinerAgent:
             self._proved_round = ch.start
 
     def _submit_proof(self, node: Node, ch) -> None:
-        held = sorted(h for h in self.store)
-        if not held:
-            # idle-only miner: nothing owed on the service side; the
-            # TEE checks the empty proof against on-chain obligations
-            node.submit_extrinsic(self.account, "audit.submit_proof",
-                                  Proof((), np.zeros((0, podr2.SECTORS),
-                                                     np.uint32),
-                                        np.zeros((0,), np.uint32)),
-                                  Proof((), np.zeros((0, podr2.SECTORS),
-                                                     np.uint32),
-                                        np.zeros((0,), np.uint32)))
-            return
-        frags = np.stack([np.frombuffer(self.store[h], dtype=np.uint8)
-                          for h in held])
-        tags = np.stack([self.tags[h] for h in held])
-        blocks = tags.shape[1]
+        """Distinct idle + service proofs, each a constant-size
+        aggregated (mu, sigma) over the owed sets FROZEN in the
+        challenge snapshot — the reference's two-proof submit_proof
+        (audit/src/lib.rs:430-479) with honest wire sizing."""
         seed = b"".join(ch.net.randoms)
-        idx, nu = podr2.gen_challenge(seed, blocks)
-        mu, sigma = podr2.prove_batch(jnp.asarray(frags), jnp.asarray(tags),
-                                      idx, nu)
-        proof = Proof(fragment_hashes=tuple(held),
-                      mu=np.asarray(mu), sigma=np.asarray(sigma))
+        snap = next(s for s in ch.miners if s.miner == self.account)
+        service = build_proof(seed, list(snap.service_frags), self.store,
+                              self.tags)
+        idle = build_proof(seed, list(snap.fillers), self.filler_store,
+                           self.filler_tags)
         node.submit_extrinsic(self.account, "audit.submit_proof",
-                              proof, proof)
+                              idle, service)
 
     # -- restoral servicing -------------------------------------------------------
     def try_repair(self, frag_hash: bytes, peers: list["MinerAgent"],
@@ -216,18 +237,39 @@ class MinerAgent:
 @codec.register
 @dataclasses.dataclass(frozen=True)
 class Proof:
-    """The opaque proof blob queued for TEE verification (mu, sigma per
-    held fragment). Chain-side size cap applies to the wire form."""
-    fragment_hashes: tuple[bytes, ...]
-    mu: np.ndarray      # [F, sectors]
-    sigma: np.ndarray   # [F]
+    """The aggregated PoDR2 proof: ONE (mu, sigma) folded over every
+    owed fragment with PRF coefficients (podr2.aggregate_coeffs). The
+    chain sees only the codec-encoded bytes and caps the REAL wire
+    size at SIGMA_MAX (runtime/src/lib.rs:992) — ~1.06 KiB here,
+    constant in the number of fragments."""
+    mu: np.ndarray      # [sectors] uint32
+    sigma: int          # field element
 
-    def __len__(self) -> int:  # the chain's SIGMA_MAX check
-        return podr2.PROOF_BYTES
+
+def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
+                tags: dict[bytes, np.ndarray]) -> bytes:
+    """Miner-side: aggregated proof over the owed set, as wire bytes.
+    Fragments the miner no longer holds simply can't contribute — the
+    fold then fails TEE verification (that's the audit)."""
+    held = [h for h in owed if h in store]
+    if not held:
+        return codec.encode(Proof(
+            mu=np.zeros((podr2.SECTORS,), np.uint32), sigma=0))
+    frags = np.stack([np.frombuffer(store[h], dtype=np.uint8)
+                      for h in held])
+    tag_arr = np.stack([tags[h] for h in held])
+    blocks = tag_arr.shape[1]
+    idx, nu = podr2.gen_challenge(seed, blocks)
+    ids = np.stack([podr2.fragment_id_from_hash(h) for h in held])
+    r = podr2.aggregate_coeffs(seed, ids)
+    mu, sigma = podr2.prove_aggregate(jnp.asarray(frags),
+                                      jnp.asarray(tag_arr), idx, nu, r)
+    return codec.encode(Proof(mu=np.asarray(mu), sigma=int(sigma)))
 
 
 class TeeAgent:
-    """Holds the PoDR2 secret; verifies queued proofs on device."""
+    """Holds the PoDR2 secret; certifies fillers and verifies queued
+    proofs on device."""
 
     def __init__(self, node: Node, controller: str, key: podr2.Podr2Key,
                  blocks_per_fragment: int):
@@ -235,8 +277,38 @@ class TeeAgent:
         self.controller = controller
         self.key = key
         self.blocks = blocks_per_fragment
+        self.account_key = node.spec.account_key(controller)
         self._submitted: set[tuple[str, int]] = set()
 
+    # -- filler certification -------------------------------------------------
+    def certify_fillers(self, miner: str, indices: list[int],
+                        blobs: list[bytes]):
+        """Check each blob IS the canonical full-size PRF stream for
+        (miner, index), tag it, and sign the hash batch bound to the
+        miner's on-chain cert nonce — the attestation
+        file_bank.upload_filler verifies (and consumes) on chain."""
+        from ..chain.file_bank import FileBank
+
+        expected_size = self.blocks * podr2.BLOCK_BYTES
+        if len(indices) != len(blobs) or len(set(indices)) != len(indices):
+            raise ValueError("indices/blobs mismatch")
+        for i, blob in zip(indices, blobs):
+            if len(blob) != expected_size \
+                    or blob != filler_bytes(miner, i, expected_size):
+                raise ValueError(f"filler {i} content not canonical")
+        hashes = [fragment_hash(b) for b in blobs]
+        ids = np.stack([podr2.fragment_id_from_hash(h) for h in hashes])
+        tags = np.asarray(podr2.tag_fragments(
+            self.key, jnp.asarray(ids),
+            jnp.asarray(np.stack([np.frombuffer(b, dtype=np.uint8)
+                                  for b in blobs]))))
+        nonce = self.node.runtime.file_bank.filler_cert_nonce(miner)
+        sig = self.account_key.sign(
+            FileBank.FILLER_CERT_CONTEXT
+            + codec.encode((miner, tuple(hashes), nonce)))
+        return hashes, tags, sig
+
+    # -- proof verification ----------------------------------------------------
     def on_block(self, node: Node) -> None:
         rt = node.runtime
         missions = rt.state.get("audit", "unverify", self.controller,
@@ -245,33 +317,47 @@ class TeeAgent:
         if not missions or ch is None:
             return
         seed = b"".join(ch.net.randoms)
+        # challenge derivation is round-constant: hoist out of _verify
         idx, nu = podr2.gen_challenge(seed, self.blocks)
         for mission in missions:
             if (mission.miner, ch.start) in self._submitted:
                 continue  # result already queued, not yet applied
-            owed = {k[0] for k, _ in rt.state.iter_prefix(
-                "file_bank", "frag_of_miner", mission.miner)}
-            ok = self._verify(mission.service_proof, owed, idx, nu)
+            snap = mission.snapshot   # owed sets frozen at round start
+            service_ok = self._verify(mission.service_proof,
+                                      list(snap.service_frags), seed,
+                                      idx, nu)
+            idle_ok = self._verify(mission.idle_proof, list(snap.fillers),
+                                   seed, idx, nu)
             self._submitted.add((mission.miner, ch.start))
             node.submit_extrinsic(self.controller,
                                   "audit.submit_verify_result",
-                                  mission.miner, ok, ok)
+                                  mission.miner, idle_ok, service_ok)
 
-    def _verify(self, proof, owed: set[bytes], idx, nu) -> bool:
-        """The proof must cover every fragment the chain says the miner
-        holds, and every (mu, sigma) must satisfy the PoDR2 equation."""
-        if not isinstance(proof, Proof):
+    def _verify(self, blob, owed: list[bytes], seed: bytes,
+                idx, nu) -> bool:
+        """Decode the (untrusted) aggregated proof bytes and check them
+        against the snapshot owed set — the miner proves exactly its
+        obligations, or fails. Malformed bytes are a failed audit,
+        never an exception."""
+        try:
+            proof = codec.decode(blob)
+        except (codec.CodecError, TypeError, ValueError):
             return False
-        if not owed.issubset(set(proof.fragment_hashes)):
+        if not (isinstance(proof, Proof) and isinstance(proof.mu, np.ndarray)
+                and proof.mu.shape == (podr2.SECTORS,)
+                and proof.mu.dtype == np.uint32
+                and isinstance(proof.sigma, int)
+                and 0 <= proof.sigma < pf.P):
             return False
-        if len(proof.fragment_hashes) == 0:
-            return True   # idle-only miner, nothing owed
-        ids = jnp.asarray(np.stack([podr2.fragment_id_from_hash(h)
-                                    for h in proof.fragment_hashes]))
-        ok = podr2.verify_batch(self.key, ids, self.blocks, idx, nu,
-                                jnp.asarray(proof.mu),
-                                jnp.asarray(proof.sigma))
-        return bool(np.all(np.asarray(ok)))
+        if not owed:
+            return proof.sigma == 0 and not proof.mu.any()
+        ids = np.stack([podr2.fragment_id_from_hash(h) for h in owed])
+        r = podr2.aggregate_coeffs(seed, ids)
+        ok = podr2.verify_aggregate(self.key, jnp.asarray(ids), self.blocks,
+                                    idx, nu, r,
+                                    jnp.asarray(proof.mu),
+                                    jnp.uint32(proof.sigma))
+        return bool(np.asarray(ok))
 
 
 class ValidatorOcw:

@@ -183,6 +183,62 @@ def prove_batch(fragments, tags, idx, nu, sectors: int = SECTORS):
     return jax.vmap(lambda d, t: prove(d, t, idx, nu, sectors))(fragments, tags)
 
 
+def aggregate_coeffs(seed_bytes: bytes, fragment_ids) -> jax.Array:
+    """Per-fragment random linear-combination coefficients r[F] for
+    cross-fragment proof aggregation, PRF-derived from the round seed
+    and each fragment id — the prover cannot choose them.
+
+    Aggregation (the SIGMA_MAX fix, runtime/src/lib.rs:992): instead
+    of shipping (mu, sigma) PER fragment (O(F KiB) on the wire), the
+    miner folds all its fragments into ONE (mu, sigma):
+
+        mu_total    = sum_f r_f * mu_f
+        sigma_total = sum_f r_f * sigma_f
+
+    The Shacham-Waters verification equation is linear in (mu, sigma),
+    so the TEE checks the fold against the fragment set the CHAIN says
+    the miner owes — constant 1028-byte proof regardless of F.
+    """
+    import hashlib
+
+    digest = hashlib.sha256(b"cess-podr2-agg:" + seed_bytes).digest()
+    w0 = int.from_bytes(digest[:4], "little")
+    w1 = int.from_bytes(digest[4:8], "little")
+    key = jax.random.fold_in(jax.random.key(np.uint32(w0)), np.uint32(w1))
+    ids = jnp.asarray(fragment_ids).reshape(-1, 2)
+
+    def one(fid):
+        k = jax.random.fold_in(jax.random.fold_in(key, fid[0]), fid[1])
+        return pf.to_field(jax.random.bits(k, (), jnp.uint32))
+
+    return jax.vmap(one)(ids)
+
+
+def prove_aggregate(fragments, tags, idx, nu, r, sectors: int = SECTORS):
+    """[F, bytes], [F, blocks], r [F] -> (mu [sectors], sigma []).
+
+    The constant-size aggregated proof across all of a miner's
+    challenged fragments (see aggregate_coeffs)."""
+    mu_f, sigma_f = prove_batch(fragments, tags, idx, nu, sectors)
+    mu = pf.summod(pf.mulmod(r[:, None], mu_f), axis=0)
+    sigma = pf.dotmod(r, sigma_f, axis=0)
+    return mu, sigma
+
+
+def verify_aggregate(key: Podr2Key, fragment_ids, num_blocks: int,
+                     idx, nu, r, mu, sigma):
+    """TEE-side check of an aggregated proof against the owed fragment
+    set (ids [F, 2]). Returns a scalar bool."""
+    ids = jnp.asarray(fragment_ids).reshape(-1, 2)
+    f_all = jax.vmap(
+        lambda i: prf_elems(key.prf_key, i, num_blocks))(ids)   # [F, B]
+    lhs_f = jax.vmap(
+        lambda f: pf.dotmod(nu, jnp.take(f, idx, axis=0), axis=0))(f_all)
+    lhs = pf.addmod(pf.dotmod(r, lhs_f, axis=0),
+                    pf.dotmod(key.alpha, mu, axis=0))
+    return lhs == sigma
+
+
 def verify_from_f(alpha, f, idx, nu, mu, sigma):
     """The verification equation given precomputed PRF values f [blocks]
     (shared by single-device verify and the sharded mesh step)."""

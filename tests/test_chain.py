@@ -36,7 +36,9 @@ def rt():
         rt.fund(w, 10_000 * D)
         rt.apply_extrinsic(w, "sminer.regnstk", w, b"peer" + w.encode(),
                            2000 * D)
-        rt.apply_extrinsic(w, "file_bank.upload_filler", 4000)  # ~31 GiB idle
+        # genesis-style idle grant (~31 GiB); the TEE-certified filler
+        # path is exercised by the dedicated filler tests below
+        rt.sminer.add_miner_idle_space(w, 4000 * constants.FRAGMENT_SIZE)
     rt.apply_extrinsic(ALICE, "storage_handler.buy_space", 20)
     rt.apply_extrinsic(ALICE, "file_bank.create_bucket", ALICE, "bkt")
     return rt
@@ -364,6 +366,87 @@ def test_audit_vote_switching_cannot_pump_count(rt):
     rt.apply_extrinsic("v1", "audit.save_challenge_info", net_a, miners,
                        sign_proposal(keys["v1"], net_a, miners))
     assert rt.audit.challenge() is not None
+
+
+def test_filler_registry_certified_upload(rt):
+    """Fillers enter the idle ledger ONLY with a TEE attestation over
+    (miner, hashes); registry is per-hash with TEE attribution
+    (ref file-bank/src/lib.rs:798-859)."""
+    from cess_tpu import codec
+    from cess_tpu.chain.file_bank import FileBank
+    from cess_tpu.crypto import ed25519
+
+    setup_tee(rt)
+    tee_key = ed25519.SigningKey.generate(b"tee1-acct")
+    rt.system.bind_account_key("tee1", tee_key.public)
+
+    def cert(miner, hashes):
+        return tee_key.sign(FileBank.FILLER_CERT_CONTEXT + codec.encode(
+            (miner, hashes, rt.file_bank.filler_cert_nonce(miner))))
+
+    hashes = tuple(bytes([i]) * 32 for i in range(3))
+    sig = cert("m1", hashes)
+    idle0 = rt.sminer.miner("m1").idle_space
+    rt.apply_extrinsic("m1", "file_bank.upload_filler", hashes, "tee1", sig)
+    assert rt.sminer.miner("m1").idle_space == idle0 + 3 * FRAG
+    assert sorted(rt.file_bank.filler_hashes("m1")) == sorted(hashes)
+    # replaying the consumed cert fails (nonce advanced)
+    with pytest.raises(DispatchError, match="BadFillerCert"):
+        rt.apply_extrinsic("m1", "file_bank.upload_filler", hashes,
+                           "tee1", sig)
+    # even a FRESH cert can't double-register the same hashes
+    with pytest.raises(DispatchError, match="FillerExists"):
+        rt.apply_extrinsic("m1", "file_bank.upload_filler", hashes,
+                           "tee1", cert("m1", hashes))
+    # in-batch duplicates can't multi-credit idle space
+    h2 = (b"\x99" * 32,)
+    with pytest.raises(DispatchError, match="InvalidCount"):
+        rt.apply_extrinsic("m1", "file_bank.upload_filler", h2 + h2,
+                           "tee1", cert("m1", h2 + h2))
+    with pytest.raises(DispatchError, match="BadFillerCert"):
+        rt.apply_extrinsic("m1", "file_bank.upload_filler", h2, "tee1",
+                           b"\x00" * 64)
+    sig2 = cert("m1", h2)
+    with pytest.raises(DispatchError, match="NonExistentTee"):
+        rt.apply_extrinsic("m1", "file_bank.upload_filler", h2,
+                           "nobody", sig2)
+    # the signature binds the miner: m2 can't reuse m1's cert
+    with pytest.raises(DispatchError, match="BadFillerCert"):
+        rt.apply_extrinsic("m2", "file_bank.upload_filler", h2,
+                           "tee1", sig2)
+
+
+def test_replace_file_report_consumes_fillers(rt):
+    from cess_tpu import codec
+    from cess_tpu.chain.file_bank import FileBank
+    from cess_tpu.crypto import ed25519
+
+    setup_tee(rt)
+    tee_key = ed25519.SigningKey.generate(b"tee1-acct")
+    rt.system.bind_account_key("tee1", tee_key.public)
+    hashes = tuple(bytes([40 + i]) * 32 for i in range(4))
+    sig = tee_key.sign(FileBank.FILLER_CERT_CONTEXT + codec.encode(
+        ("m1", hashes, rt.file_bank.filler_cert_nonce("m1"))))
+    rt.apply_extrinsic("m1", "file_bank.upload_filler", hashes, "tee1", sig)
+    rt.state.put("file_bank", "pending_replace", "m1", 2)
+    idle0 = rt.sminer.miner("m1").idle_space
+    rt.apply_extrinsic("m1", "file_bank.replace_file_report", hashes[:2])
+    assert rt.sminer.miner("m1").idle_space == idle0 - 2 * FRAG
+    assert sorted(rt.file_bank.filler_hashes("m1")) == sorted(hashes[2:])
+    assert rt.file_bank.pending_replacements("m1") == 0
+    # the ORIGINAL cert can't be replayed to re-credit the deleted
+    # fillers (cert nonce consumed at first registration)
+    with pytest.raises(DispatchError, match="BadFillerCert"):
+        rt.apply_extrinsic("m1", "file_bank.upload_filler", hashes,
+                           "tee1", sig)
+    # can't replace more than pending, nor unknown fillers
+    with pytest.raises(DispatchError, match="InvalidCount"):
+        rt.apply_extrinsic("m1", "file_bank.replace_file_report",
+                           hashes[2:])
+    rt.state.put("file_bank", "pending_replace", "m1", 5)
+    with pytest.raises(DispatchError, match="NonExistentFiller"):
+        rt.apply_extrinsic("m1", "file_bank.replace_file_report",
+                           (b"\x77" * 32,))
 
 
 def test_tee_verify_timeout_slashes_scheduler(rt):

@@ -104,6 +104,61 @@ def test_proof_size_within_chain_cap():
     assert podr2.PROOF_BYTES <= SIGMA_MAX
 
 
+def test_aggregate_proof_completeness_and_soundness():
+    """Cross-fragment aggregation: one (mu, sigma) proves many
+    fragments; omitting or corrupting any owed fragment fails."""
+    key = podr2.Podr2Key.generate(11)
+    frags = make_fragments(5, seed=6)
+    hashes = [bytes([i]) * 32 for i in range(5)]
+    ids = jnp.asarray(np.stack([podr2.fragment_id_from_hash(h)
+                                for h in hashes]))
+    tags = podr2.tag_fragments(key, ids, frags)
+    blocks = tags.shape[1]
+    seed = b"agg-round-randomness"
+    idx, nu = podr2.gen_challenge(seed, blocks)
+    r = podr2.aggregate_coeffs(seed, ids)
+    mu, sigma = podr2.prove_aggregate(jnp.asarray(frags), tags, idx, nu, r)
+    assert bool(np.asarray(podr2.verify_aggregate(
+        key, ids, blocks, idx, nu, r, mu, sigma)))
+    # dropping one owed fragment from the fold fails verification
+    mu4, sigma4 = podr2.prove_aggregate(jnp.asarray(frags[:4]), tags[:4],
+                                        idx, nu, r[:4])
+    assert not bool(np.asarray(podr2.verify_aggregate(
+        key, ids, blocks, idx, nu, r, mu4, sigma4)))
+    # corrupting a challenged byte of any fragment fails
+    bad = frags.copy()
+    bad[2, int(np.asarray(idx)[0]) * podr2.BLOCK_BYTES] ^= 1
+    mu_b, sigma_b = podr2.prove_aggregate(jnp.asarray(bad), tags, idx, nu, r)
+    assert not bool(np.asarray(podr2.verify_aggregate(
+        key, ids, blocks, idx, nu, r, mu_b, sigma_b)))
+
+
+def test_aggregate_proof_wire_size_constant():
+    """The codec-encoded aggregated proof stays under SIGMA_MAX no
+    matter how many fragments it covers (VERDICT Weak #3 fix)."""
+    from cess_tpu import codec
+    from cess_tpu.constants import SIGMA_MAX
+    from cess_tpu.node.offchain import Proof, build_proof
+
+    key = podr2.Podr2Key.generate(12)
+    sizes = []
+    for count in (1, 50):
+        frags = make_fragments(count, seed=13)
+        hashes = [bytes([i % 256]) * 16 + i.to_bytes(16, "little")
+                  for i in range(count)]
+        ids = jnp.asarray(np.stack([podr2.fragment_id_from_hash(h)
+                                    for h in hashes]))
+        tags = np.asarray(podr2.tag_fragments(key, ids, frags))
+        store = {h: frags[i].tobytes() for i, h in enumerate(hashes)}
+        tagmap = {h: tags[i] for i, h in enumerate(hashes)}
+        blob = build_proof(b"size-round", sorted(hashes), store, tagmap)
+        assert isinstance(blob, bytes) and len(blob) <= SIGMA_MAX
+        proof = codec.decode(blob)
+        assert isinstance(proof, Proof)
+        sizes.append(len(blob))
+    assert sizes[0] == sizes[1], "proof size must not grow with F"
+
+
 def test_tag_oracle_parity_numpy_bigint():
     """Tag math matches a bigint reference implementation exactly."""
     key = podr2.Podr2Key.generate(5)
