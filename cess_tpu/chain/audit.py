@@ -59,6 +59,7 @@ class MinerSnapshot:
     fillers: tuple = ()           # owed filler hashes, sorted
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class ChallengeInfo:
     net: NetSnapshot
@@ -69,6 +70,7 @@ class ChallengeInfo:
     cleared: bool = False
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class ProveInfo:
     miner: str

@@ -16,6 +16,7 @@ from .state import DispatchError, State
 PALLET = "cacher"
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class CacherInfo:
     payee: str

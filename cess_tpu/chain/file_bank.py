@@ -38,6 +38,7 @@ ACTIVE = "active"
 MINER_COOLING_BLOCKS = constants.ONE_DAY_BLOCKS  # exit cooling ledger
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class SegmentInfo:
     hash: bytes
@@ -52,6 +53,7 @@ class UserBrief:
     bucket: str
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class DealInfo:
     file_hash: bytes
@@ -64,6 +66,7 @@ class DealInfo:
     needed_space: int
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class FileInfo:
     file_size: int
@@ -74,6 +77,7 @@ class FileInfo:
     needed_space: int
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class RestoralOrder:
     miner: str              # claimant ("" = unclaimed)
@@ -85,6 +89,7 @@ class RestoralOrder:
     deadline: int           # claim deadline (re-opens on expiry)
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class RestoralTarget:
     """Exit cooling ledger gating withdrawal (functions.rs:543-573)."""

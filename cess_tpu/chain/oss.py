@@ -8,11 +8,13 @@ from __future__ import annotations
 
 import dataclasses
 
+from .. import codec
 from .state import DispatchError, State
 
 PALLET = "oss"
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class OssInfo:
     peer_id: bytes

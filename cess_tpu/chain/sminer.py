@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from .. import constants
+from .. import codec, constants
 from .balances import Balances
 from .state import DispatchError, State
 
@@ -26,6 +26,7 @@ EXITING = "exiting"     # exit prep done, fragments being restored
 LOCKED = "locked"       # force-exited by punishment
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class MinerInfo:
     beneficiary: str
@@ -38,6 +39,7 @@ class MinerInfo:
     lock_space: int
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class RewardOrder:
     total: int            # full order amount

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from .. import constants
+from .. import codec, constants
 from .balances import Balances
 from .state import DispatchError, State
 
@@ -26,6 +26,7 @@ DEAD = "dead"
 FROZEN_GRACE_BLOCKS = 10 * constants.ONE_DAY_BLOCKS  # FrozenDays=10 (runtime :955-957)
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class OwnedSpace:
     total_space: int      # bytes

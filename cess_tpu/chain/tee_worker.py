@@ -21,11 +21,13 @@ from __future__ import annotations
 import dataclasses
 
 from ..crypto.rsa import RsaPublicKey, rsa_verify_pkcs1v15
+from .. import codec
 from .state import DispatchError, State
 
 PALLET = "tee_worker"
 
 
+@codec.register
 @dataclasses.dataclass(frozen=True)
 class TeeWorkerInfo:
     controller: str

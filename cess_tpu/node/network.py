@@ -110,7 +110,6 @@ class Node:
                 extrinsics = tuple(self.tx_pool)
                 self.tx_pool.clear()
             snapshot = (self.runtime.state.block,
-                        len(self.runtime.state.event_history),
                         list(self.runtime.state.events))
             self.runtime.state.begin_tx()
             self._execute(claim, extrinsics)
@@ -132,10 +131,11 @@ class Node:
     def abort_proposal(self, requeue: bool = True) -> None:
         """Fork choice lost: roll the whole block back; re-queue txs
         unless the caller owns tx distribution (Network does)."""
-        _, extrinsics, (block0, hist0, events0) = self._proposal
+        _, extrinsics, (block0, events0) = self._proposal
         self.runtime.state.rollback_tx()
         self.runtime.state.block = block0
-        del self.runtime.state.event_history[hist0:]
+        # the aborted block's archive stamped everything with block0
+        self.runtime.state.truncate_history(block0)
         self.runtime.state.events[:] = events0
         self._proposal = None
         if requeue:
