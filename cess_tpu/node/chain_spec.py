@@ -54,9 +54,18 @@ class ChainSpec:
             f"{self.chain_id}/account:{account}".encode())
 
     def genesis_hash(self) -> bytes:
-        """Chain identity bound into every signature (replay domain)."""
-        return hashlib.sha256(
-            f"cess-tpu-genesis:{self.chain_id}:{self.name}".encode()).digest()
+        """Chain identity bound into every signature (replay domain).
+        Covers the FULL genesis configuration — two chains differing
+        in any endowment, validator, or parameter have different
+        signing domains."""
+        from .. import codec
+
+        return hashlib.sha256(b"cess-tpu-genesis:" + codec.encode((
+            self.name, self.chain_id, self.endowed,
+            tuple((v.account, v.bond) for v in self.validators),
+            self.era_blocks, self.epoch_blocks, self.fragment_count,
+            self.max_validators, self.audit_challenge_life,
+            self.audit_verify_life, self.sudo))).digest()
 
     def build_runtime(self) -> Runtime:
         rt = Runtime(RuntimeConfig(
@@ -80,6 +89,42 @@ class ChainSpec:
         rt.audit.set_keys(tuple(v.account for v in self.validators))
         rt.state.archive_events()
         return rt
+
+
+def spec_to_json(spec: ChainSpec) -> dict:
+    """Reproducible-genesis export (the reference's raw chain specs,
+    node/src/chain_spec.rs:318-434): every field that determines
+    genesis state, plus the derived genesis hash for integrity."""
+    return {
+        "name": spec.name, "chain_id": spec.chain_id,
+        "endowed": [[w, a] for w, a in spec.endowed],
+        "validators": [[v.account, v.bond] for v in spec.validators],
+        "era_blocks": spec.era_blocks, "epoch_blocks": spec.epoch_blocks,
+        "fragment_count": spec.fragment_count,
+        "max_validators": spec.max_validators,
+        "audit_challenge_life": spec.audit_challenge_life,
+        "audit_verify_life": spec.audit_verify_life,
+        "sudo": spec.sudo,
+        "genesis_hash": "0x" + spec.genesis_hash().hex(),
+    }
+
+
+def spec_from_json(data: dict) -> ChainSpec:
+    spec = ChainSpec(
+        name=data["name"], chain_id=data["chain_id"],
+        endowed=tuple((w, a) for w, a in data["endowed"]),
+        validators=tuple(ValidatorGenesis(a, b)
+                         for a, b in data["validators"]),
+        era_blocks=data["era_blocks"], epoch_blocks=data["epoch_blocks"],
+        fragment_count=data["fragment_count"],
+        max_validators=data["max_validators"],
+        audit_challenge_life=data["audit_challenge_life"],
+        audit_verify_life=data["audit_verify_life"],
+        sudo=data.get("sudo"))
+    want = data.get("genesis_hash")
+    if want and "0x" + spec.genesis_hash().hex() != want:
+        raise ValueError("chain spec genesis hash mismatch")
+    return spec
 
 
 def dev_spec(era_blocks: int = 60, epoch_blocks: int = 20) -> ChainSpec:

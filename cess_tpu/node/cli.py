@@ -8,15 +8,25 @@
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import sys
 import time
 
 from ..crypto import ed25519
-from .chain_spec import dev_spec, local_spec
+from .chain_spec import dev_spec, local_spec, spec_from_json, spec_to_json
 from .network import Network, Node
-from .rpc import RpcServer, _encode
+from .rpc import RpcServer
+
+
+def _load_spec(chain: str, validators: int):
+    """dev | local | path-to-exported-spec.json (reproducible
+    genesis, chain_spec.rs:318-434 analog)."""
+    if chain == "dev":
+        return dev_spec()
+    if chain == "local":
+        return local_spec(validators)
+    with open(chain) as f:
+        return spec_from_json(json.load(f))
 
 
 def main(argv=None) -> int:
@@ -25,7 +35,8 @@ def main(argv=None) -> int:
                     choices=["run", "build-spec", "key"])
     ap.add_argument("--dev", action="store_true",
                     help="single-authority dev chain")
-    ap.add_argument("--chain", default="dev", choices=["dev", "local"])
+    ap.add_argument("--chain", default="dev",
+                    help="dev | local | path to an exported spec JSON")
     ap.add_argument("--validators", type=int, default=4)
     ap.add_argument("--blocks", type=int, default=0,
                     help="produce N blocks then exit (0 = run forever)")
@@ -33,6 +44,8 @@ def main(argv=None) -> int:
                     help="seconds between slots (0 = as fast as possible)")
     ap.add_argument("--rpc-port", type=int, default=0,
                     help="serve JSON-RPC on this port (0 = off)")
+    ap.add_argument("--base-path", default=None,
+                    help="persist chain data here and resume on restart")
     ap.add_argument("--suri", default="dev-seed", help="key seed material")
     args = ap.parse_args(argv)
 
@@ -42,14 +55,19 @@ def main(argv=None) -> int:
                           "seed": "0x" + key.seed.hex()}))
         return 0
 
-    spec = dev_spec() if (args.dev or args.chain == "dev") \
-        else local_spec(args.validators)
+    spec = dev_spec() if args.dev else _load_spec(args.chain,
+                                                  args.validators)
     if args.subcommand == "build-spec":
-        print(json.dumps(_encode(dataclasses.asdict(spec)), indent=2))
+        print(json.dumps(spec_to_json(spec), indent=2))
         return 0
 
+    import os
+
     nodes = [Node(spec, f"node-{v.account}",
-                  {v.account: spec.session_key(v.account)})
+                  {v.account: spec.session_key(v.account)},
+                  base_path=(os.path.join(args.base_path,
+                                          f"node-{v.account}")
+                             if args.base_path else None))
              for v in spec.validators]
     net = Network(nodes)
     rpc = None
@@ -57,7 +75,7 @@ def main(argv=None) -> int:
         rpc = RpcServer(nodes[0], port=args.rpc_port).start()
         print(f"JSON-RPC on 127.0.0.1:{rpc.port}", file=sys.stderr)
     produced = 0
-    slot = 1
+    slot = max(len(nodes[0].chain), 1)
     try:
         while args.blocks == 0 or produced < args.blocks:
             if net.run_slot(slot) is not None:

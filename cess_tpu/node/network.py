@@ -34,7 +34,9 @@ class Header:
     claim: SlotClaim | None    # None only for genesis
 
     def hash(self) -> bytes:
-        return hashlib.sha256(repr(self).encode()).digest()
+        # codec-canonical (NOT repr): identical bytes on every process
+        # and across the disk/gossip wire
+        return hashlib.sha256(codec.encode(self)).digest()
 
 
 @codec.register
@@ -46,7 +48,9 @@ class Block:
 
 class Node:
     def __init__(self, spec: ChainSpec, name: str,
-                 keystore: dict[str, object] | None = None):
+                 keystore: dict[str, object] | None = None,
+                 base_path: str | None = None,
+                 snapshot_interval: int = 50):
         self.spec = spec
         self.name = name
         # dev keystore: session keys for the accounts this node runs
@@ -62,6 +66,51 @@ class Node:
         self.offchain_agents: list = []
         self.finalized: int = 0
         self._proposal: tuple | None = None
+        # bodies kept for serving peer sync (a real deployment serves
+        # from the BlockStore; the in-process harness keeps them hot)
+        self.block_bodies: dict[int, Block] = {}
+        self.base_path = base_path
+        self.snapshot_interval = snapshot_interval
+        self.store = None
+        if base_path:
+            import os
+
+            from . import store as _store
+
+            os.makedirs(base_path, exist_ok=True)
+            # fast path: state checkpoint, then replay the block tail
+            _store.load_snapshot(base_path, self)
+            self.store = _store.BlockStore(
+                os.path.join(base_path, _store.BLOCKS_FILE))
+            for block in self.store:
+                self.block_bodies[block.header.number] = block
+                if block.header.number >= len(self.chain):
+                    self.import_block(block, _persist=False)
+
+    def _persist_block(self, block: Block) -> None:
+        self.block_bodies[block.header.number] = block
+        if self.store is not None:
+            self.store.append(block)
+            if self.snapshot_interval \
+                    and block.header.number % self.snapshot_interval == 0:
+                from . import store as _store
+
+                _store.write_snapshot(self.base_path, self)
+
+    def sync_from(self, peer: "Node") -> int:
+        """Catch up missed blocks from a peer's served bodies (the
+        restart/warp-sync path, ref service.rs:259-274). Returns the
+        number of blocks imported."""
+        imported = 0
+        while len(self.chain) <= peer.chain[-1].number:
+            body = peer.block_bodies.get(len(self.chain))
+            if body is None:
+                break
+            self.import_block(body)
+            imported += 1
+        self.finalized = max(self.finalized,
+                             min(peer.finalized, self.chain[-1].number))
+        return imported
 
     # -- tx pool ---------------------------------------------------------------
     def submit_extrinsic(self, origin: str, call: str, *args, **kwargs) -> None:
@@ -122,10 +171,11 @@ class Node:
         return None
 
     def commit_proposal(self) -> None:
-        header, _, _ = self._proposal
+        header, extrinsics, _ = self._proposal
         self.runtime.state.commit_tx()
         self._proposal = None
         self.chain.append(header)
+        self._persist_block(Block(header=header, extrinsics=extrinsics))
         self._post_block(header.claim)
 
     def abort_proposal(self, requeue: bool = True) -> None:
@@ -174,7 +224,7 @@ class Node:
             self.authorities = elected
 
     # -- import -------------------------------------------------------------------
-    def import_block(self, block: Block) -> None:
+    def import_block(self, block: Block, _persist: bool = True) -> None:
         """Verify the claim, re-execute, check the state root."""
         header = block.header
         if header.number != len(self.chain):
@@ -192,6 +242,10 @@ class Node:
                 f"{self.name}: state root mismatch at #{header.number} — "
                 "replicas diverged")
         self.chain.append(header)
+        if _persist:
+            self._persist_block(block)
+        else:
+            self.block_bodies[header.number] = block
         self._post_block(header.claim)
 
 
@@ -201,10 +255,17 @@ class Network:
 
     def __init__(self, nodes: list[Node]):
         self.nodes = nodes
-        # tx gossip: one shared mempool (instant propagation)
-        shared: list[tuple] = []
+        # tx gossip: one shared mempool (instant propagation); dedupe
+        # by identity — nodes re-networked after a peer restart may
+        # already share one pool object
+        shared: list[SignedExtrinsic] = []
+        seen: set[int] = set()
         for node in nodes:
-            shared.extend(node.tx_pool)
+            for tx in node.tx_pool:
+                if id(tx) not in seen:
+                    seen.add(id(tx))
+                    shared.append(tx)
+        for node in nodes:
             node.tx_pool = shared
 
     def run_slot(self, slot: int) -> Block | None:
