@@ -175,12 +175,18 @@ class Audit:
         # voters kept as a SORTED tuple: frozenset repr order is
         # PYTHONHASHSEED-dependent and would poison the state root
         # across processes
-        voters = self.state.get(PALLET, "proposal", digest,
-                                default=((), now))[0]
+        voters, born = self.state.get(PALLET, "proposal", digest,
+                                      default=((), now))
+        if born + self.challenge_life < now:
+            # stale proposal: old votes must not count toward quorum —
+            # this vote starts a fresh accumulation window
+            voters, born = (), now
         if validator in voters:
             raise DispatchError("audit.AlreadyProposed")
         voters = tuple(sorted((*voters, validator)))
-        self.state.put(PALLET, "proposal", digest, (voters, now))
+        # keep the FIRST-SEEN born stamp: refreshing it on every vote
+        # would let a trickle of votes keep a digest alive forever
+        self.state.put(PALLET, "proposal", digest, (voters, born))
         # prune stale proposals so failed rounds don't leak state
         for (k,), (_, born) in list(self.state.iter_prefix(PALLET,
                                                            "proposal")):

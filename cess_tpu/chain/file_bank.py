@@ -473,13 +473,18 @@ class FileBank:
         (lib.rs:798-859 delete_filler)."""
         if not self.state.contains(PALLET, "filler", miner, filler_hash):
             raise DispatchError("file_bank.NonExistentFiller")
-        self.state.delete(PALLET, "filler", miner, filler_hash)
         m = self.sminer.miner(miner)
+        if m is not None and m.idle_space < constants.FRAGMENT_SIZE:
+            # the filler's space is currently locked for a deal:
+            # deleting now would strand the reservation and drift the
+            # registry against the idle ledger (invariant:
+            # idle + lock + pending_replace*FRAG == fillers*FRAG)
+            raise DispatchError("file_bank.IdleSpaceLocked", miner)
+        self.state.delete(PALLET, "filler", miner, filler_hash)
         if m is not None:
-            freed = min(m.idle_space, constants.FRAGMENT_SIZE)
             self.state.put("sminer", "miner", miner, dataclasses.replace(
-                m, idle_space=m.idle_space - freed))
-            self.storage.sub_total_idle_space(freed)
+                m, idle_space=m.idle_space - constants.FRAGMENT_SIZE))
+            self.storage.sub_total_idle_space(constants.FRAGMENT_SIZE)
 
     def replace_file_report(self, miner: str,
                             filler_hashes: tuple[bytes, ...]) -> None:
@@ -492,8 +497,18 @@ class FileBank:
         if count <= 0 or count > pending:
             raise DispatchError("file_bank.InvalidCount",
                                 f"{count} > pending {pending}")
+        if len(set(filler_hashes)) != count:
+            raise DispatchError("file_bank.InvalidCount", "duplicate hash")
         for h in filler_hashes:
-            self.delete_filler(miner, h)    # raises on unknown hash
+            if not self.state.contains(PALLET, "filler", miner, h):
+                raise DispatchError("file_bank.NonExistentFiller")
+        # registry-only removal: the replaced space already left the
+        # idle ledger when the deal's lock converted to service
+        # (unlock_space_to_service at calculate_end) — delete_filler
+        # here would subtract it a second time and drift
+        # idle + lock + pending*FRAG below fillers*FRAG
+        for h in filler_hashes:
+            self.state.delete(PALLET, "filler", miner, h)
         self.state.put(PALLET, "pending_replace", miner, pending - count)
         self.state.deposit_event(PALLET, "ReplaceFiller", miner=miner,
                                  count=count)

@@ -66,6 +66,7 @@ SIGNED_CALLS = {
     "file_bank.upload_declaration", "file_bank.transfer_report",
     "file_bank.delete_file", "file_bank.ownership_transfer",
     "file_bank.upload_filler", "file_bank.replace_file_report",
+    "file_bank.delete_filler",
     "file_bank.generate_restoral_order", "file_bank.claim_restoral_order",
     "file_bank.restoral_order_complete", "file_bank.miner_exit_prep",
     "file_bank.miner_withdraw",
@@ -173,6 +174,16 @@ class Runtime:
         except DispatchError:
             self.state.rollback_tx()
             raise
+        except Exception as e:
+            # A validly-signed extrinsic can still carry arbitrary arg
+            # *values* (codec.decode checks structure, not call
+            # schemas): a TypeError/ValueError inside the call must
+            # become a deterministic skip, never escape mid-block with
+            # the tx open — the reference gets this for free from typed
+            # SCALE call decoding (runtime/src/lib.rs:1564-1574).
+            self.state.rollback_tx()
+            raise DispatchError(
+                "system.BadCallArgs", f"{call}: {type(e).__name__}") from e
         self.state.commit_tx()
         return result
 
@@ -303,6 +314,11 @@ class Runtime:
                 self.state.rollback_tx()
                 self.state.deposit_event("scheduler", "TaskFailed",
                                          name=name, error=e.name)
+            except Exception as e:
+                self.state.rollback_tx()
+                self.state.deposit_event(
+                    "scheduler", "TaskFailed", name=name,
+                    error=f"scheduler.TaskPanicked:{type(e).__name__}")
             else:
                 self.state.commit_tx()
 

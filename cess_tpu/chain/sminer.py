@@ -226,9 +226,7 @@ class Sminer:
                 if o.tranches_left <= 0:
                     continue
                 amt = o.each_share if o.tranches_left > 1 \
-                    else o.total - o.released - o.each_share * 0  # remainder in last
-                if o.tranches_left == 1:
-                    amt = o.total - o.released
+                    else o.total - o.released  # remainder in last tranche
                 pay += amt
                 o = dataclasses.replace(o, released=o.released + amt,
                                         tranches_left=o.tranches_left - 1)

@@ -43,6 +43,14 @@ class CodecError(ValueError):
     pass
 
 
+# Nesting bound for both encode and decode: a 2 KiB blob (SIGMA_MAX)
+# can otherwise nest ~1024 one-element tuples and blow the Python
+# recursion limit — RecursionError from a peer-supplied proof must not
+# crash the TEE worker or prevent block-log replay. 32 is far above any
+# legitimate protocol structure (extrinsics nest ~4 deep).
+MAX_DEPTH = 32
+
+
 # -- varints -----------------------------------------------------------------
 def _write_uvarint(out: bytearray, n: int) -> None:
     while True:
@@ -77,7 +85,15 @@ def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
 
 
 # -- encode ------------------------------------------------------------------
-def _encode_into(out: bytearray, obj: Any) -> None:
+def _encode_one(obj: Any, depth: int) -> bytes:
+    out = bytearray()
+    _encode_into(out, obj, depth)
+    return bytes(out)
+
+
+def _encode_into(out: bytearray, obj: Any, depth: int = 0) -> None:
+    if depth > MAX_DEPTH:
+        raise CodecError("nesting too deep")
     if obj is None:
         out.append(_NONE)
     elif obj is True:
@@ -118,26 +134,27 @@ def _encode_into(out: bytearray, obj: Any) -> None:
         fields = dataclasses.fields(obj)
         _write_uvarint(out, len(fields))
         for f in fields:
-            _encode_into(out, getattr(obj, f.name))
+            _encode_into(out, getattr(obj, f.name), depth + 1)
     elif isinstance(obj, tuple):
         out.append(_TUPLE)
         _write_uvarint(out, len(obj))
         for item in obj:
-            _encode_into(out, item)
+            _encode_into(out, item, depth + 1)
     elif isinstance(obj, list):
         out.append(_LIST)
         _write_uvarint(out, len(obj))
         for item in obj:
-            _encode_into(out, item)
+            _encode_into(out, item, depth + 1)
     elif isinstance(obj, dict):
-        entries = sorted((encode(k), encode(v)) for k, v in obj.items())
+        entries = sorted((_encode_one(k, depth + 1), _encode_one(v, depth + 1))
+                         for k, v in obj.items())
         out.append(_DICT)
         _write_uvarint(out, len(entries))
         for ek, ev in entries:
             out.extend(ek)
             out.extend(ev)
     elif isinstance(obj, (set, frozenset)):
-        entries = sorted(encode(i) for i in obj)
+        entries = sorted(_encode_one(i, depth + 1) for i in obj)
         out.append(_SET)
         _write_uvarint(out, len(entries))
         for e in entries:
@@ -160,7 +177,10 @@ def _read_raw(data: bytes, pos: int) -> tuple[bytes, int]:
     return data[pos:pos + n], pos + n
 
 
-def _decode_at(data: bytes, pos: int) -> tuple[Any, int]:
+def _decode_at(data: bytes, pos: int,
+               depth: int = 0) -> tuple[Any, int]:
+    if depth > MAX_DEPTH:
+        raise CodecError("nesting too deep")
     if pos >= len(data):
         raise CodecError("truncated value")
     tag = data[pos]
@@ -205,14 +225,14 @@ def _decode_at(data: bytes, pos: int) -> tuple[Any, int]:
             raise CodecError(f"field count mismatch for {raw.decode()}")
         values = []
         for _ in range(n):
-            v, pos = _decode_at(data, pos)
+            v, pos = _decode_at(data, pos, depth + 1)
             values.append(v)
         return cls(*values), pos
     if tag in (_TUPLE, _LIST, _SET):
         n, pos = _read_uvarint(data, pos)
         items = []
         for _ in range(n):
-            v, pos = _decode_at(data, pos)
+            v, pos = _decode_at(data, pos, depth + 1)
             items.append(v)
         if tag == _TUPLE:
             return tuple(items), pos
@@ -223,8 +243,8 @@ def _decode_at(data: bytes, pos: int) -> tuple[Any, int]:
         n, pos = _read_uvarint(data, pos)
         d = {}
         for _ in range(n):
-            k, pos = _decode_at(data, pos)
-            v, pos = _decode_at(data, pos)
+            k, pos = _decode_at(data, pos, depth + 1)
+            v, pos = _decode_at(data, pos, depth + 1)
             d[k] = v
         return d, pos
     raise CodecError(f"unknown tag: {tag}")

@@ -130,11 +130,18 @@ def load_snapshot(base_path: str, node) -> bool:
     except (codec.CodecError, ValueError):
         return False
     state = node.runtime.state
+    prev_kv, prev_block = state.kv, state.block
     state.kv = dict(kv)
     state.block = block
     state.rebuild_root_cache()
     if chain and state.state_root() != chain[-1].state_root:
-        raise ValueError("snapshot state root mismatch — refusing to load")
+        # Corrupt-but-decodable snapshot: restore the pristine state
+        # and report failure so the caller falls back to replaying
+        # blocks.bin — bricking startup here would make a recoverable
+        # corruption fatal.
+        state.kv, state.block = prev_kv, prev_block
+        state.rebuild_root_cache()
+        return False
     node.chain = list(chain)
     node.rrsc.randomness = {int(k): v for k, v in randomness.items()}
     node.rrsc._epoch_vrf = {int(k): list(v) for k, v in epoch_vrf.items()}

@@ -115,14 +115,16 @@ def psum_mod(x, axis_name: str):
     """Exact modular psum across a mesh axis (JAX only).
 
     Values in [0, p) are limb-split so plain uint32 psums cannot
-    overflow for any realistic device count (<= 65536), then recombined
-    mod p — the collective analog of summod.
+    overflow for any device count <= 65536 (lo/hi <= ndev * (2^16 - 1)
+    < 2^32), then recombined exactly mod p: both psum results are first
+    reduced into [0, p) before the final addmod, so no intermediate can
+    exceed 2^32 at any device count the limb bound admits.
     """
     import jax
 
     lo = jax.lax.psum(x & MASK16, axis_name)
     hi = jax.lax.psum(x >> 16, axis_name)
-    return to_field(lo + _rot16(to_field(hi)))
+    return addmod(to_field(lo), _rot16(to_field(hi)))
 
 
 def powmod(a: int, e: int) -> int:

@@ -431,7 +431,9 @@ def test_replace_file_report_consumes_fillers(rt):
     rt.state.put("file_bank", "pending_replace", "m1", 2)
     idle0 = rt.sminer.miner("m1").idle_space
     rt.apply_extrinsic("m1", "file_bank.replace_file_report", hashes[:2])
-    assert rt.sminer.miner("m1").idle_space == idle0 - 2 * FRAG
+    # replace is registry-only: the replaced space left the idle ledger
+    # at lock->service conversion, not here
+    assert rt.sminer.miner("m1").idle_space == idle0
     assert sorted(rt.file_bank.filler_hashes("m1")) == sorted(hashes[2:])
     assert rt.file_bank.pending_replacements("m1") == 0
     # the ORIGINAL cert can't be replayed to re-credit the deleted
@@ -561,3 +563,82 @@ def test_extrinsic_rollback_on_error(rt):
                            seg_hashes(2), UserBrief(BOB, "f", "nobucket"),
                            2 * 16 * MIB)
     assert rt.state.state_root() == root0
+
+
+def test_filler_idle_ledger_invariant(rt):
+    """Registry/ledger invariant at every quiescent point of a full
+    deal driven purely by TEE-certified filler space:
+    fillers*FRAG == idle + lock + pending_replace*FRAG per miner."""
+    from cess_tpu import codec
+    from cess_tpu.chain.file_bank import FileBank
+    from cess_tpu.crypto import ed25519
+
+    # rebase every miner's idle ledger onto certified fillers only
+    for w in MINERS:
+        m = rt.sminer.miner(w)
+        rt.storage_handler.sub_total_idle_space(m.idle_space)
+        rt.state.put("sminer", "miner", w,
+                     __import__("dataclasses").replace(m, idle_space=0))
+    setup_tee(rt)
+    tee_key = ed25519.SigningKey.generate(b"tee1-acct")
+    rt.system.bind_account_key("tee1", tee_key.public)
+    for w in MINERS:
+        hashes = tuple(w.encode() + bytes([i]) * 31 for i in range(8))
+        sig = tee_key.sign(FileBank.FILLER_CERT_CONTEXT + codec.encode(
+            (w, hashes, rt.file_bank.filler_cert_nonce(w))))
+        rt.apply_extrinsic(w, "file_bank.upload_filler", hashes, "tee1", sig)
+
+    def check(stage):
+        for w in MINERS:
+            m = rt.sminer.miner(w)
+            lhs = len(rt.file_bank.filler_hashes(w)) * FRAG
+            rhs = (m.idle_space + m.lock_space
+                   + rt.file_bank.pending_replacements(w) * FRAG)
+            assert lhs == rhs, (stage, w, lhs, rhs)
+
+    check("after filler upload")
+    declare(rt)
+    check("after declaration (space locked)")
+    deal = rt.file_bank.deal(FILE)
+    for w in deal.assigned:
+        rt.apply_extrinsic(w, "file_bank.transfer_report", FILE)
+    rt.apply_extrinsic("root", "file_bank.calculate_end", FILE)
+    check("after calculate_end (lock -> service, pending credited)")
+    # miners consume their pending replacements
+    for w in deal.assigned:
+        n = rt.file_bank.pending_replacements(w)
+        victims = tuple(rt.file_bank.filler_hashes(w))[:n]
+        rt.apply_extrinsic(w, "file_bank.replace_file_report", victims)
+    check("after replace_file_report")
+    # standalone delete frees idle; refuses when idle is all locked
+    w = deal.assigned[0]
+    before = rt.sminer.miner(w).idle_space
+    rt.file_bank.delete_filler(w, rt.file_bank.filler_hashes(w)[0])
+    assert rt.sminer.miner(w).idle_space == before - FRAG
+    check("after delete_filler")
+    m = rt.sminer.miner(w)
+    rt.sminer.lock_space(w, m.idle_space)   # lock everything that's left
+    with pytest.raises(DispatchError, match="IdleSpaceLocked"):
+        rt.file_bank.delete_filler(w, rt.file_bank.filler_hashes(w)[0])
+    rt.sminer.unlock_space(w, m.idle_space)
+    check("after lock/unlock round-trip")
+
+
+def test_audit_stale_proposal_votes_do_not_count(rt):
+    """A vote landing after a proposal's accumulation window expired
+    must start a FRESH window: expired votes can neither reach quorum
+    nor keep a digest alive forever (trickle-vote leak)."""
+    keys = audit_keys(rt, ("v1", "v2", "v3"))
+    net, miners = rt.audit.generation_challenge()
+    rt.apply_extrinsic("v1", "audit.save_challenge_info", net, miners,
+                       sign_proposal(keys["v1"], net, miners))
+    rt.advance_blocks(rt.audit.challenge_life + 1)
+    # v2's vote arrives after expiry: old v1 vote must not combine
+    rt.apply_extrinsic("v2", "audit.save_challenge_info", net, miners,
+                       sign_proposal(keys["v2"], net, miners))
+    assert rt.audit.challenge() is None, \
+        "expired vote counted toward quorum"
+    # v1 can vote again in the fresh window and now quorum is honest
+    rt.apply_extrinsic("v1", "audit.save_challenge_info", net, miners,
+                       sign_proposal(keys["v1"], net, miners))
+    assert rt.audit.challenge() is not None
