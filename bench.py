@@ -1,78 +1,293 @@
-"""Headline benchmark: RS(4+8) batched encode throughput per chip.
+"""Benchmark suite: all five BASELINE.md metrics, one JSON line each.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline is measured GiB/s (data-in) over the 12 GiB/s per-chip
-target from BASELINE.md.
+Metrics (targets from BASELINE.md / BASELINE.json):
+- rs_4erasure_decode_GiBps_per_chip   target >= 8 GiB/s   (config 3)
+- cpu_speedup_encode_x                target >= 40x vs the native C++
+  single-thread CPU reed-solomon baseline (ops/rs_native.py), measured
+  on this same host (config 1/2)
+- fragment_repair_p99_ms              north-star latency metric; the
+  baseline budget is one 6 s block interval (a restoral-market repair
+  must comfortably fit within a block, BASELINE.md block time)
+- podr2_100k_tag_verify_frags_per_s   tag-gen + challenge-verify over
+  100k fragments (config 4); baseline = the rate that finishes 100k
+  fragments within one challenge round (300 blocks x 6 s = 1800 s)
+- rs_4p8_encode_GiBps_per_chip        target >= 12 GiB/s  (config 2)
+  printed LAST (the headline metric keeps the tail position). NOTE:
+  BENCH_r01/r02 timed StoragePipeline.forward (encode + tag in one
+  program); from r03 this metric is encode-ONLY, matching what
+  BASELINE.md's 12 GiB/s target names — tag throughput is now covered
+  by the podr2 metric, so the r02->r03 change in this number reflects
+  the narrower timed region, not a kernel change.
 
 Timing notes: through the axon tunnel ``block_until_ready`` does not
-synchronize, so iterations are chained (out feeds back in is impossible
-for encode's shape change — instead a scalar of each output is folded
-into the next input) and completion is forced by a scalar device fetch,
-amortized over many iterations.
+synchronize, so each benchmark chains iterations by folding a scalar
+of the previous output into the next (donated) input, and completion
+is forced by one scalar device fetch amortized over all iterations.
 """
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
 import numpy as np
+
+BLOCK_MS = 6000.0             # 6 s block (BASELINE.md)
+CHALLENGE_ROUND_S = 300 * 6   # challenge_life_base blocks x block time
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": round(float(value), 3),
+        "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 3),
+    }), flush=True)
+
+
+def chain_timer(step, init_carry, iters: int):
+    """Run ``carry = step(carry)`` iters times; sync once; return s/iter.
+    ``step`` must return a carry whose last element is a small scalar
+    jax array (fetched to force the chain)."""
+    carry = step(init_carry)
+    _ = np.asarray(carry[-1])  # sync warmup + compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = step(carry)
+    _ = np.asarray(carry[-1])
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_encode(jnp, jax, batch, seg_size, iters):
+    """RS(4+8) encode-only GiB/s (data-in) per chip."""
+    from cess_tpu.ops import gf
+    from cess_tpu.ops.rs import _MatrixApply, default_strategy
+
+    k, m = 4, 8
+    frag = seg_size // k
+    parity = _MatrixApply(gf.cauchy_parity_matrix(k, m), default_strategy())
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(carry):
+        data, salt = carry
+        data = data.at[0, 0, 0].set(salt)
+        p = parity(data)
+        return data, p[0, 0, 0]
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (batch, k, frag), dtype=np.uint8))
+    dt = chain_timer(step, (data, jnp.uint8(0)), iters)
+    return batch * seg_size / 2**30 / dt
+
+
+def bench_decode(jnp, jax, batch, seg_size, iters):
+    """4-erasure decode GiB/s (recovered data) per chip: shards
+    0, 1, 6, 7 of 12 lost; original data rebuilt from survivors
+    (2, 3) data + (4, 5) parity."""
+    from cess_tpu.ops import gf
+    from cess_tpu.ops.rs import _MatrixApply, default_strategy
+
+    k, m = 4, 8
+    frag = seg_size // k
+    present = (2, 3, 4, 5)
+    dec = _MatrixApply(gf.decode_matrix(k, m, present), default_strategy())
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(carry):
+        surv, salt = carry
+        surv = surv.at[0, 0, 0].set(salt)
+        data = dec(surv)
+        return surv, data[0, 0, 0]
+
+    rng = np.random.default_rng(1)
+    surv = jnp.asarray(rng.integers(0, 256, (batch, k, frag), dtype=np.uint8))
+    dt = chain_timer(step, (surv, jnp.uint8(0)), iters)
+    return batch * seg_size / 2**30 / dt
+
+
+def bench_cpu_baseline(seg_size, reps) -> tuple[float, bool]:
+    """Native C++ single-thread RS(4+8) encode GiB/s on this host —
+    the 'single-node CPU reed-solomon' baseline (the reference's
+    off-chain encode is sequential CPU, SURVEY.md §2.4). Returns
+    (GiB/s, native). If the native build is unavailable the NumPy
+    oracle stands in, and the metric is RENAMED so an inflated
+    speedup can never masquerade as the native-baseline number."""
+    k, m = 4, 8
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (1, k, seg_size // k), dtype=np.uint8)
+    try:
+        from cess_tpu.ops.rs_native import NativeCodec
+
+        codec, native = NativeCodec(k, m, threads=1), True
+    except ImportError:
+        from cess_tpu.ops.rs_ref import ReferenceCodec
+
+        codec, native = ReferenceCodec(k, m), False
+    codec.encode_parity(data)  # warm tables/pages
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        codec.encode_parity(data)
+    dt = (time.perf_counter() - t0) / reps
+    return seg_size / 2**30 / dt, native
+
+
+def bench_repair_p99(jnp, jax, frag_size, reps):
+    """p99 latency (ms) of a single-fragment repair: rebuild one lost
+    8 MiB fragment of one segment from 4 survivors. Host-observed per
+    call, including dispatch + a scalar result fetch (the repaired
+    fragment itself stays on device for the downstream hash/store
+    step)."""
+    from cess_tpu.ops import gf
+    from cess_tpu.ops.rs import _MatrixApply, default_strategy
+
+    k, m = 4, 8
+    present, missing = (1, 2, 3, 4), (0,)
+    rep = _MatrixApply(gf.repair_matrix(k, m, present, missing),
+                       default_strategy())
+
+    @jax.jit
+    def repair(surv, salt):
+        surv = surv.at[0, 0].set(salt)
+        out = rep(surv)
+        return out[0, 0]   # scalar forces the compute when fetched
+
+    rng = np.random.default_rng(3)
+    surv = jnp.asarray(rng.integers(0, 256, (k, frag_size), dtype=np.uint8))
+    salt = np.uint8(0)
+    _ = np.asarray(repair(surv, salt))  # compile
+    lat = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        salt = np.asarray(repair(surv, salt))
+        lat.append((time.perf_counter() - t0) * 1000)
+    return float(np.percentile(lat, 99))
+
+
+def bench_podr2(jnp, jax, resident, frag_size, total, verify_chunk):
+    """Tag-gen + challenge-verify throughput (fragments/s) over a
+    ``total``-fragment workload (config 4: 100k fragments).
+
+    Tag-gen streams the workload through a resident device batch
+    (buffers donated, content salted per iteration so no dispatch is
+    cached). Verify checks one aggregated-style proof batch per chunk
+    with unique fragment ids throughout — PRF regeneration, the
+    dominant verifier cost, is paid for every fragment."""
+    from cess_tpu.ops import podr2
+
+    params = podr2.Podr2Params()
+    key = podr2.Podr2Key.generate(7, params)
+    blocks = params.blocks_for(frag_size)
+
+    # -- tag-gen ------------------------------------------------------------
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def tag_step(frags, ids, salt):
+        frags = frags.at[0, 0].set(salt)
+        tags = podr2.tag_fragments(key, ids, frags)
+        # full reduction: the fetched scalar depends on EVERY tag, so
+        # XLA cannot dead-code-eliminate any of the tag computation
+        # (tag math is plain jnp, not an opaque kernel)
+        return frags, jnp.sum(tags, dtype=jnp.uint32)
+
+    rng = np.random.default_rng(4)
+    frags = jnp.asarray(
+        rng.integers(0, 256, (resident, frag_size), dtype=np.uint8))
+    iters = max(1, total // resident)
+    ids0 = jnp.arange(resident, dtype=jnp.uint32)
+    frags, salt = tag_step(frags, ids0, jnp.uint8(0))
+    _ = np.asarray(salt)
+    t0 = time.perf_counter()
+    for it in range(iters):
+        ids = jnp.arange(it * resident, (it + 1) * resident,
+                         dtype=jnp.uint32)
+        frags, salt = tag_step(frags, ids, salt.astype(jnp.uint8))
+    _ = np.asarray(salt)
+    tag_t = time.perf_counter() - t0
+
+    # -- challenge-verify ---------------------------------------------------
+    idx, nu = podr2.gen_challenge(b"bench-round", blocks)
+
+    @jax.jit
+    def verify_step(ids2, mu, sigma):
+        ok = podr2.verify_batch(key, ids2, blocks, idx, nu, mu, sigma)
+        return jnp.sum(ok.astype(jnp.int32))
+
+    mu = jnp.zeros((verify_chunk, params.sectors), dtype=jnp.uint32)
+    sigma = jnp.zeros((verify_chunk,), dtype=jnp.uint32)
+    ids2 = jnp.zeros((verify_chunk, 2), dtype=jnp.uint32)
+    _ = np.asarray(verify_step(ids2, mu, sigma))  # compile
+    chunks = max(1, total // verify_chunk)
+    acc = 0
+    t0 = time.perf_counter()
+    for c in range(chunks):
+        ids2 = jnp.stack([
+            jnp.arange(c * verify_chunk, (c + 1) * verify_chunk,
+                       dtype=jnp.uint32),
+            jnp.full((verify_chunk,), acc & 0xFF, dtype=jnp.uint32)], axis=1)
+        acc = int(np.asarray(verify_step(ids2, mu, sigma)))
+    verify_t = time.perf_counter() - t0
+
+    # combined pipeline rate: harmonic combination of per-stage rates
+    return 1.0 / (tag_t / (iters * resident)
+                  + verify_t / (chunks * verify_chunk))
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, quick")
     ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--metrics", default="all",
+                    help="comma list: decode,speedup,repair,podr2,encode")
     args = ap.parse_args()
+    known = {"decode", "speedup", "repair", "podr2", "encode"}
+    which = set(args.metrics.split(",")) if args.metrics != "all" else known
+    if which - known:
+        raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
+                         f"choose from {sorted(known)}")
 
     import jax
     import jax.numpy as jnp
 
-    from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
-
     on_tpu = jax.default_backend() != "cpu"
-    k, m = 4, 8
     if args.smoke or not on_tpu:
-        batch, seg_size, iters = 2, 1 * 2**20, 3
+        batch, seg, iters = 2, 1 * 2**20, 3
+        frag = seg // 4            # scaled-down stand-in fragment
+        resident, total, vchunk = 8, 32, 16
+        repair_reps, cpu_reps = 20, 2
     else:
-        batch, seg_size, iters = 16, 16 * 2**20, args.iters
+        batch, seg, iters = 32, 16 * 2**20, args.iters
+        frag = 8 * 2**20           # protocol FRAGMENT_SIZE (BASELINE.md)
+        # resident cap: pack_bytes materializes ~4x the fragment batch
+        # as u32 temps; 128 x 8 MiB keeps peak HBM ~9 GiB < 15.75 GiB
+        resident, total, vchunk = 128, 100_000, 4096
+        repair_reps, cpu_reps = 200, 3
 
-    cfg = PipelineConfig(k=k, m=m, segment_size=seg_size)
-    pipe = StoragePipeline(cfg)
+    encode_gibps = None
+    if "encode" in which or "speedup" in which:
+        encode_gibps = bench_encode(jnp, jax, batch, seg, iters)
 
-    import functools
+    if "decode" in which:
+        v = bench_decode(jnp, jax, batch, seg, iters)
+        emit("rs_4erasure_decode_GiBps_per_chip", v, "GiB/s", v / 8.0)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(segments, salt):
-        # fold a scalar from the previous output into the (donated)
-        # input so no two dispatches are identical — defeats dispatch
-        # caching without copying the batch
-        segments = segments.at[0, 0].set(salt)
-        out = pipe.forward(segments)
-        return segments, out["fragments"][0, 0, 0]
+    if "speedup" in which:
+        cpu, native = bench_cpu_baseline(seg, cpu_reps)
+        name = "cpu_speedup_encode_x" if native \
+            else "cpu_speedup_encode_vs_numpy_fallback_x"
+        emit(name, encode_gibps / cpu, "x", (encode_gibps / cpu) / 40.0)
 
-    rng = np.random.default_rng(0)
-    segments = jnp.asarray(
-        rng.integers(0, 256, (batch, seg_size), dtype=np.uint8)
-    )
-    segments, salt = step(segments, jnp.uint8(0))
-    _ = np.asarray(salt)  # sync warmup
+    if "repair" in which:
+        p99 = bench_repair_p99(jnp, jax, frag, repair_reps)
+        emit("fragment_repair_p99_ms", p99, "ms", BLOCK_MS / p99)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        segments, salt = step(segments, salt)
-    _ = np.asarray(salt)  # forces the whole chain
-    dt = (time.perf_counter() - t0) / iters
+    if "podr2" in which:
+        v = bench_podr2(jnp, jax, resident, frag, total, vchunk)
+        emit("podr2_100k_tag_verify_frags_per_s", v, "fragments/s",
+             v / (100_000 / CHALLENGE_ROUND_S))
 
-    gib_in = batch * seg_size / 2**30
-    value = gib_in / dt
-    baseline = 12.0  # GiB/s per chip, BASELINE.md
-    print(json.dumps({
-        "metric": "rs_4p8_encode_GiBps_per_chip",
-        "value": round(value, 3),
-        "unit": "GiB/s",
-        "vs_baseline": round(value / baseline, 3),
-    }))
+    if "encode" in which:
+        emit("rs_4p8_encode_GiBps_per_chip", encode_gibps, "GiB/s",
+             encode_gibps / 12.0)
 
 
 if __name__ == "__main__":
