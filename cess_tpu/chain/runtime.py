@@ -23,6 +23,7 @@ from .balances import Balances
 from .cacher import Cacher
 from .extrinsic import SignedExtrinsic, verify_signature
 from .file_bank import FileBank
+from .offences import Offences
 from .oss import Oss
 from .scheduler import Scheduler
 from .scheduler_credit import SchedulerCredit
@@ -72,6 +73,7 @@ SIGNED_CALLS = {
     "file_bank.miner_withdraw",
     "audit.save_challenge_info", "audit.submit_proof",
     "audit.submit_verify_result",
+    "offences.report_equivocation",
 }
 DISPATCHABLE = SIGNED_CALLS | ROOT_ONLY
 
@@ -83,6 +85,9 @@ FEELESS = {
     "audit.save_challenge_info",
     "audit.submit_proof",
     "audit.submit_verify_result",
+    # evidence-carrying, self-validating (ref submits equivocation
+    # reports as validated unsigned transactions)
+    "offences.report_equivocation",
 }
 
 
@@ -111,6 +116,7 @@ class Runtime:
             s, self.config.credit_period_blocks or self.config.era_blocks)
         self.tee_worker = TeeWorker(s, staking=self.staking,
                                     credit=self.credit)
+        self.offences = Offences(s, self.staking, self.genesis_hash)
         self.file_bank = FileBank(s, self.balances, self.storage_handler,
                                   self.sminer, self.scheduler,
                                   fragment_count=self.config.fragment_count,
@@ -138,6 +144,7 @@ class Runtime:
             "tee_worker": self.tee_worker,
             "file_bank": self.file_bank,
             "audit": self.audit,
+            "offences": self.offences,
         }
         self._update_randomness()
 

@@ -111,6 +111,21 @@ class Staking:
     def current_era(self) -> int:
         return self.state.get(PALLET, "era", default=0)
 
+    # -- offence slashing ---------------------------------------------------------
+    def slash_fraction(self, who: str, permill: int) -> int:
+        """Slash ``permill``/1000 of the current bond to treasury
+        (consensus-fault punishment; the reference routes offences
+        through pallet-staking's slashing machinery). Returns the
+        amount taken."""
+        b = self.bonded(who)
+        taken = b * permill // 1000
+        if taken:
+            self.state.put(PALLET, "bond", who, b - taken)
+            self.balances.slash_reserved(who, taken, TREASURY)
+        self.state.deposit_event(PALLET, "Slashed", who=who, amount=taken,
+                                 permill=permill)
+        return taken
+
     # -- scheduler slash (slashing.rs:694-705) ------------------------------------
     def slash_scheduler(self, stash: str) -> None:
         """5% of MinValidatorBond from the stash's bond -> treasury."""

@@ -195,6 +195,29 @@ class State:
                 self._root_add(key, old)
         del self.events[emark:]
 
+    # -- block undo (fork-choice support) -----------------------------------
+    def commit_tx_undo(self) -> list[tuple[tuple, Any]]:
+        """Commit the open transaction but RETURN its journal segment
+        as an undo log. Fork choice keeps one per non-finalized block
+        so a reorg can rewind state to the fork point in O(changes)
+        instead of replaying the whole chain (the role of Substrate's
+        tree-backed storage overlays in the reference)."""
+        jmark, _ = self._tx_marks.pop()
+        undo = self._journal[jmark:]
+        del self._journal[jmark:]
+        return undo
+
+    def apply_undo(self, undo: list[tuple[tuple, Any]]) -> None:
+        """Rewind one committed block: restore every journaled old
+        value (reverse order), maintaining the incremental root."""
+        for key, old in reversed(undo):
+            self._root_sub(key)
+            if old is _TOMBSTONE:
+                self.kv.pop(key, None)
+            else:
+                self.kv[key] = old
+                self._root_add(key, old)
+
     # -- roots --------------------------------------------------------------
     def state_root(self) -> bytes:
         """The incrementally-maintained multiset root (see module

@@ -1,0 +1,167 @@
+"""Vote-based finality: signed vote exchange, 2/3 counting,
+equivocation detection, persisted justifications.
+
+The reference runs a GRANDPA voter loop gossiping signed votes per
+round and importing justifications
+(/root/reference/node/src/service.rs:556-580). This gadget is the
+framework-native equivalent, round-simplified: round r finalizes at
+most one block; every authority votes for its best chain's block at
+height r (GRANDPA's "ghost of the best chain" collapsed to the head
+ancestor at that height); 2/3 distinct signed votes for the same hash
+form a justification that finalizes the block and all ancestors.
+
+Safety properties kept from GRANDPA:
+- a vote is a SIGNED, self-contained statement (chain/offences.py
+  Vote) — replicas verify against the on-chain session-key registry;
+- two votes by one voter in one round for different hashes are
+  cryptographic proof of equivocation, reportable on chain
+  (offences.report_equivocation) where staking slashes + chills;
+- finality never reverts: justified blocks bound fork choice (a node
+  never reorgs below its finalized height), and a justification on a
+  side branch FORCES the node onto that branch.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .. import codec
+from ..chain.offences import Vote, sign_vote
+
+
+@codec.register
+@dataclasses.dataclass(frozen=True)
+class Justification:
+    """Proof of finality for (target_hash, round): >= 2/3 of the
+    authority set's signed votes. Persisted with the chain so a
+    restarted/syncing node can verify finality without replaying the
+    vote exchange (the reference persists GRANDPA justifications in
+    the block store)."""
+
+    round: int
+    target_hash: bytes
+    target_number: int
+    votes: tuple[Vote, ...]
+
+
+class FinalityGadget:
+    """Per-node vote tracker. The node feeds it local keys + incoming
+    votes; it emits outgoing votes, detects equivocations, and
+    surfaces justifications when a target reaches 2/3."""
+
+    def __init__(self, node):
+        self.node = node
+        # round -> target_hash -> {voter: Vote}
+        self._tally: dict[int, dict[bytes, dict[str, Vote]]] = {}
+        # round -> voter -> first-seen Vote (for equivocation detection)
+        self._first: dict[int, dict[str, Vote]] = {}
+        self.equivocations: list[tuple[Vote, Vote]] = []
+        self.justifications: dict[int, Justification] = {}
+
+    # -- outgoing ----------------------------------------------------------
+    def cast_votes(self) -> list[Vote]:
+        """Votes from every local authority key for the current HEAD
+        (round = head height; a justification finalizes the target and
+        every ancestor). Voting only the head keeps liveness across
+        reorgs: a voter that committed to a dead branch at height h
+        can never re-vote round h (that would be equivocation), but
+        the chain outgrows h and a fresh round finalizes past it."""
+        node = self.node
+        out = []
+        head = node.chain[-1]
+        rnd = head.number
+        if rnd <= node.finalized:
+            return out
+        for account, key in node.keystore.items():
+            if account not in node.authorities:
+                continue
+            if account in self._first.get(rnd, {}):
+                continue   # never double-vote (that's equivocation)
+            v = sign_vote(key, node.runtime.genesis_hash(), account,
+                          rnd, head.hash(), rnd)
+            self.on_vote(v)   # count own vote
+            out.append(v)
+        return out
+
+    # -- incoming ----------------------------------------------------------
+    def on_vote(self, vote: Vote) -> None:
+        """Tally a (possibly remote) vote. Invalid signatures are
+        dropped; equivocations are recorded as evidence and the vote
+        is NOT counted (first vote stands, GRANDPA-style)."""
+        from ..crypto import ed25519
+
+        node = self.node
+        if vote.voter not in node.authorities:
+            return
+        if vote.round <= node.finalized:
+            return   # stale round
+        pub = node.runtime.state.get("system", "session_key", vote.voter)
+        if pub is None or not ed25519.verify(
+                pub, vote.signing_payload(node.runtime.genesis_hash()),
+                vote.signature):
+            return
+        first = self._first.setdefault(vote.round, {})
+        prev = first.get(vote.voter)
+        if prev is not None:
+            if prev.target_hash != vote.target_hash:
+                self.equivocations.append((prev, vote))
+            return
+        first[vote.voter] = vote
+        self._tally.setdefault(vote.round, {}).setdefault(
+            vote.target_hash, {})[vote.voter] = vote
+        self._try_finalize(vote.round, vote.target_hash)
+
+    def _try_finalize(self, rnd: int, target_hash: bytes) -> None:
+        node = self.node
+        votes = self._tally.get(rnd, {}).get(target_hash, {})
+        n_auth = len(node.authorities)
+        if 3 * len(votes) < 2 * n_auth or rnd in self.justifications:
+            return
+        just = Justification(round=rnd, target_hash=target_hash,
+                             target_number=rnd,
+                             votes=tuple(votes[v]
+                                         for v in sorted(votes)))
+        self.justifications[rnd] = just
+        node.on_justification(just)
+        # rounds below the justified height are settled; older
+        # justifications are implied by the newest (finality is
+        # ancestor-transitive), so retention stays O(1)
+        for r in [r for r in self._tally if r < rnd]:
+            self._tally.pop(r, None)
+            self._first.pop(r, None)
+        for r in [r for r in self.justifications if r < rnd]:
+            del self.justifications[r]
+
+    # -- evidence ----------------------------------------------------------
+    def take_equivocations(self) -> list[tuple[Vote, Vote]]:
+        evs, self.equivocations = self.equivocations, []
+        return evs
+
+    def verify_justification(self, just: Justification) -> bool:
+        """Check a peer-supplied justification: 2/3 distinct authority
+        votes, all validly signed over the claimed target (used when
+        syncing finality without having seen the votes live)."""
+        from ..crypto import ed25519
+
+        node = self.node
+        # judge against the authority set in force AT the target (era
+        # rotation makes the set height-dependent); falls back to the
+        # current set for targets we have not yet imported
+        target = node.headers.get(just.target_hash)
+        authorities = node.authorities_at(target.parent) \
+            if target is not None else node.authorities
+        seen = set()
+        for v in just.votes:
+            if not isinstance(v, Vote) or v.voter in seen:
+                return False
+            if v.round != just.round or v.target_hash != just.target_hash \
+                    or v.target_number != just.target_number:
+                return False
+            if v.voter not in authorities:
+                return False
+            pub = node.runtime.state.get("system", "session_key", v.voter)
+            if pub is None or not ed25519.verify(
+                    pub, v.signing_payload(node.runtime.genesis_hash()),
+                    v.signature):
+                return False
+            seen.add(v.voter)
+        return 3 * len(seen) >= 2 * len(authorities)

@@ -1,0 +1,348 @@
+"""TCP gossip transport: nodes as separate OS processes.
+
+The reference's node talks libp2p — block announcement, tx
+propagation, GRANDPA vote gossip, and catch-up sync between processes
+(/root/reference/node/src/service.rs:259-274,508-537). This module is
+the framework-native equivalent over plain TCP: length-prefixed
+canonical-codec frames carrying (msg_type, payload) tuples, full-mesh
+peering, flood gossip with seen-set dedup, and a walk-back sync
+request for missed blocks. The in-process ``Network`` driver and this
+transport run the SAME ``Node``: consensus, fork choice and finality
+live in the node; this layer only moves bytes.
+
+Fault injection (``FaultPolicy``) drops or reorders outbound messages
+deterministically — the gossip layer must converge anyway via sync
+requests (tested in tests/test_net.py with real processes).
+
+Wire frame: [4-byte LE length][codec bytes]; payload tuples:
+  ("tx", SignedExtrinsic)          tx propagation
+  ("block", Block)                 block announcement (body included)
+  ("vote", Vote)                   finality vote gossip
+  ("status", (head_n, head_hash, finalized))  keepalive / sync trigger
+  ("sync_request", from_number)    catch-up ask
+  ("sync_response", (Block, ...))  canonical tail (capped)
+  ("just", Justification)          finality proof propagation
+"""
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+import threading
+import time
+
+from .. import codec
+from ..chain.state import DispatchError
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 64 * 1024 * 1024
+SYNC_BATCH = 64
+SYNC_LOOKBACK = 8   # re-request a short tail to cover small forks
+
+
+@dataclasses.dataclass
+class FaultPolicy:
+    """Deterministic outbound faults for tests: drop every Nth
+    message, optionally delay each send."""
+
+    drop_every: int = 0     # 0 = never drop
+    delay_s: float = 0.0
+    _counter: int = 0
+
+    def allow(self) -> bool:
+        self._counter += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return not (self.drop_every and self._counter % self.drop_every == 0)
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.alive = True
+
+    def send(self, raw: bytes) -> None:
+        with self.send_lock:
+            self.sock.sendall(_LEN.pack(len(raw)) + raw)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def _read_frame(sock: socket.socket) -> bytes | None:
+    head = b""
+    while len(head) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME:
+        return None
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class NodeService:
+    """One node process: TCP listener + outbound peers + slot-timed
+    authoring loop, all feeding a single Node under one lock."""
+
+    def __init__(self, node, port: int, peers: list[int],
+                 host: str = "127.0.0.1", slot_time: float = 0.2,
+                 genesis_time: float = 0.0,
+                 faults: FaultPolicy | None = None):
+        self.node = node
+        # all processes must agree on slot numbering (slot is signed
+        # into VRF claims and drives epoch derivation): slots count
+        # from a SHARED genesis wall-clock instant, not process start
+        self.genesis_time = genesis_time
+        self.host = host
+        self.port = port
+        self.peer_ports = peers
+        self.slot_time = slot_time
+        self.faults = faults
+        self.lock = threading.RLock()
+        self.conns: list[_Conn] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._seen: set[bytes] = set()   # gossip dedup (frame hashes)
+        self.errors: list[str] = []      # swallowed faults, for tests/ops
+        self._listener: socket.socket | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(16)
+        self._listener = srv
+        self._spawn(self._accept_loop, srv)
+        for p in self.peer_ports:
+            self._spawn(self._dial_loop, p)
+        self._spawn(self._author_loop)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for c in list(self.conns):
+            c.close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _spawn(self, fn, *args) -> None:
+        t = threading.Thread(target=fn, args=args, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- connections --------------------------------------------------------
+    def _accept_loop(self, srv: socket.socket) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = srv.accept()
+            except OSError:
+                return
+            conn = _Conn(sock)
+            self.conns.append(conn)
+            self._spawn(self._recv_loop, conn)
+
+    def _dial_loop(self, port: int) -> None:
+        """Keep one outbound connection to a peer alive (retry)."""
+        while not self._stop.is_set():
+            try:
+                sock = socket.create_connection((self.host, port),
+                                                timeout=2.0)
+                sock.settimeout(None)
+            except OSError:
+                time.sleep(0.05)
+                continue
+            conn = _Conn(sock)
+            self.conns.append(conn)
+            self._send_status(conn)
+            self._recv_loop(conn)   # blocks until closed
+            if conn in self.conns:
+                self.conns.remove(conn)
+            time.sleep(0.05)
+
+    def _recv_loop(self, conn: _Conn) -> None:
+        while not self._stop.is_set() and conn.alive:
+            try:
+                raw = _read_frame(conn.sock)
+            except OSError:
+                break
+            if raw is None:
+                break
+            try:
+                msg = codec.decode(raw)
+                self._handle(msg, conn)
+            except (codec.CodecError, ValueError, DispatchError,
+                    TypeError, KeyError):
+                # malformed or stale traffic from a peer must never
+                # kill the service
+                continue
+        conn.close()
+
+    # -- sending ------------------------------------------------------------
+    def _send(self, conn: _Conn, msg) -> None:
+        if self.faults is not None and not self.faults.allow():
+            return
+        try:
+            conn.send(codec.encode(msg))
+        except OSError:
+            conn.close()
+
+    def broadcast(self, msg, mark_seen: bool = True) -> None:
+        raw = codec.encode(msg)
+        if mark_seen:
+            import hashlib
+
+            self._seen.add(hashlib.sha256(raw).digest())
+        for conn in list(self.conns):
+            if conn.alive:
+                if self.faults is not None and not self.faults.allow():
+                    continue
+                try:
+                    conn.send(raw)
+                except OSError:
+                    conn.close()
+
+    def _send_status(self, conn: _Conn) -> None:
+        with self.lock:
+            head = self.node.head()
+            msg = ("status", (head.number, head.hash(),
+                              self.node.finalized))
+        self._send(conn, msg)
+
+    # -- gossip handlers ----------------------------------------------------
+    def _handle(self, msg, conn: _Conn) -> None:
+        import hashlib
+
+        kind, payload = msg
+        raw_hash = hashlib.sha256(codec.encode(msg)).digest()
+        if kind in ("tx", "block", "vote", "just"):
+            if raw_hash in self._seen:
+                return
+            self._seen.add(raw_hash)
+        if kind == "tx":
+            with self.lock:
+                try:
+                    self.node.submit_signed(payload)
+                except DispatchError:
+                    return   # invalid or duplicate: do not re-gossip
+            self.broadcast(msg, mark_seen=False)
+        elif kind == "block":
+            ok = self._import(payload, conn)
+            if ok:
+                self.broadcast(msg, mark_seen=False)
+                self._after_chain_move()
+        elif kind == "vote":
+            with self.lock:
+                self.node.finality.on_vote(payload)
+            self.broadcast(msg, mark_seen=False)
+        elif kind == "just":
+            with self.lock:
+                if payload.target_number > self.node.finalized \
+                        and self.node.finality.verify_justification(payload):
+                    self.node.finality.justifications[payload.round] = payload
+                    self.node.on_justification(payload)
+        elif kind == "status":
+            peer_head, _, _ = payload
+            with self.lock:
+                ours = self.node.head().number
+            if peer_head > ours:
+                self._send(conn, ("sync_request",
+                                  max(1, ours - SYNC_LOOKBACK)))
+        elif kind == "sync_request":
+            with self.lock:
+                blocks = []
+                for n in range(payload, payload + SYNC_BATCH):
+                    b = self.node.block_bodies.get(n)
+                    if b is None:
+                        break
+                    blocks.append(b)
+            if blocks:
+                self._send(conn, ("sync_response", tuple(blocks)))
+        elif kind == "sync_response":
+            moved = False
+            for b in payload:
+                if self._import(b, conn):
+                    moved = True
+            if moved:
+                self._after_chain_move()
+
+    def _import(self, block, conn: _Conn) -> bool:
+        with self.lock:
+            try:
+                self.node.import_block(block)
+                return True
+            except ValueError as e:
+                if "unknown parent" in str(e):
+                    self._send(conn, (
+                        "sync_request",
+                        max(1, self.node.head().number - SYNC_LOOKBACK)))
+                return False
+
+    def _after_chain_move(self) -> None:
+        """Cast + gossip finality votes and any new justification."""
+        with self.lock:
+            votes = self.node.finality.cast_votes()
+            fin = self.node.finalized
+            just = self.node.finality.justifications.get(fin)
+        for v in votes:
+            self.broadcast(("vote", v))
+        if just is not None:
+            self.broadcast(("just", just))
+
+    # -- authoring ----------------------------------------------------------
+    def _author_loop(self) -> None:
+        """Wall-clock slots shared across processes on one host: each
+        process independently computes the slot index, authors when its
+        key wins, commits immediately and gossips — competing blocks
+        are resolved by fork choice at import, votes settle finality."""
+        last_slot = -1
+        while not self._stop.is_set():
+            slot = int((time.time() - self.genesis_time) / self.slot_time)
+            if slot < 1:
+                time.sleep(self.slot_time / 10)
+                continue
+            if slot == last_slot:
+                time.sleep(self.slot_time / 10)
+                continue
+            last_slot = slot
+            blk = None
+            with self.lock:
+                try:
+                    blk = self.node.try_author(slot)
+                    if blk is not None:
+                        self.node.commit_proposal()
+                except Exception as e:   # noqa: BLE001 — author loop must survive
+                    self.errors.append(f"author slot {slot}: {e!r}")
+                    if self.node._proposal is not None:
+                        self.node.abort_proposal()
+                    blk = None
+            if blk is not None:
+                self.broadcast(("block", blk))
+                self._after_chain_move()
+            for conn in list(self.conns):
+                if conn.alive:
+                    self._send_status(conn)
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, xt) -> None:
+        with self.lock:
+            self.node.submit_signed(xt)
+        self.broadcast(("tx", xt))

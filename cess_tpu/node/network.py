@@ -1,16 +1,26 @@
-"""Block production, import, fork choice and finality (in-process net).
+"""Block production, tree-based import, fork choice, reorg, finality.
 
-The reference's node assembles libp2p gossip + RRSC authoring + GRANDPA
-voting (SURVEY.md §3.1, §3.4); multi-node behavior is only exercised on
-live testnets. Here the same roles run as an in-process network
-harness: every Node owns a full Runtime replica, authors blocks when
-its keys win the slot lottery, imports and RE-EXECUTES peers' blocks
-verifying the VRF claim and state root (state-machine replication), and
-finalizes with 2/3 vote counting (GRANDPA's role, round-simplified).
+The reference's node assembles libp2p gossip + RRSC authoring + a
+GRANDPA voter loop (SURVEY.md §3.1, §3.4;
+/root/reference/node/src/service.rs:448-506,556-580); multi-node
+behavior is only exercised on live testnets. Here the same roles with
+a real block TREE:
 
-This doubles as the determinism test rig the reference lacks in-repo:
-any divergence between replicas surfaces as a state-root mismatch at
-import.
+- every Node owns a full Runtime replica and imports blocks onto any
+  known parent (side branches included), re-executing and verifying
+  VRF claim + state root only when a branch becomes canonical;
+- fork choice: heaviest chain by (height, cumulative primary-slot
+  count); reorgs rewind per-block state undo logs (O(changes), the
+  role of Substrate's tree-backed storage) and replay the winning
+  branch;
+- finality is a vote exchange (cess_tpu/node/finality.py): signed
+  votes, 2/3 justifications, equivocation evidence reportable on
+  chain. Finalized blocks bound fork choice; a justification on a
+  side branch forces the node onto it.
+
+The in-process Network driver at the bottom synchronizes slots across
+nodes — the socket transport (cess_tpu/node/net.py) runs the same Node
+between OS processes.
 """
 from __future__ import annotations
 
@@ -22,6 +32,7 @@ from ..chain.extrinsic import SignedExtrinsic, sign_extrinsic
 from ..chain.state import DispatchError
 from .chain_spec import ChainSpec
 from .consensus import Rrsc, SlotClaim, elect_validators
+from .finality import FinalityGadget, Justification
 
 
 @codec.register
@@ -35,15 +46,32 @@ class Header:
 
     def hash(self) -> bytes:
         # codec-canonical (NOT repr): identical bytes on every process
-        # and across the disk/gossip wire
-        return hashlib.sha256(codec.encode(self)).digest()
+        # and across the disk/gossip wire. Memoized — hashing is on the
+        # fork-choice/finality hot path (not a codec field, so encoding
+        # and equality are unaffected).
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hashlib.sha256(codec.encode(self)).digest()
+            object.__setattr__(self, "_hash", h)
+        return h
 
 
 @codec.register
 @dataclasses.dataclass(frozen=True)
 class Block:
     header: Header
-    extrinsics: tuple  # ((origin, call, args, kwargs), ...)
+    extrinsics: tuple  # (SignedExtrinsic, ...)
+
+
+@dataclasses.dataclass
+class _UndoRec:
+    """Everything needed to rewind one canonical block in a reorg."""
+
+    state_undo: list
+    block_before: int
+    events_before: list
+    authorities_before: tuple[str, ...]
+    vrf_note: tuple[int, bytes] | None   # (epoch, output) if primary
 
 
 class Node:
@@ -62,12 +90,22 @@ class Node:
                          state_root=self.runtime.state.state_root(),
                          author="", claim=None)
         self.chain: list[Header] = [genesis]
+        # block tree: all known headers/bodies by hash; side branches
+        # are stored unexecuted until fork choice adopts them
+        gh = genesis.hash()
+        self.headers: dict[bytes, Header] = {gh: genesis}
+        self.bodies: dict[bytes, Block] = {}
+        self._primaries: dict[bytes, int] = {gh: 0}
+        self._undo: dict[bytes, _UndoRec] = {}
+        # authority set AFTER applying each executed block (era
+        # rotation makes the set branch-dependent)
+        self._authset: dict[bytes, tuple[str, ...]] = {gh: self.authorities}
         self.tx_pool: list[SignedExtrinsic] = []
         self.offchain_agents: list = []
         self.finalized: int = 0
+        self.finality = FinalityGadget(self)
         self._proposal: tuple | None = None
-        # bodies kept for serving peer sync (a real deployment serves
-        # from the BlockStore; the in-process harness keeps them hot)
+        # canonical bodies by number, kept for serving peer sync
         self.block_bodies: dict[int, Block] = {}
         self.base_path = base_path
         self.snapshot_interval = snapshot_interval
@@ -83,9 +121,45 @@ class Node:
             self.store = _store.BlockStore(
                 os.path.join(base_path, _store.BLOCKS_FILE))
             for block in self.store:
-                self.block_bodies[block.header.number] = block
-                if block.header.number >= len(self.chain):
+                try:
                     self.import_block(block, _persist=False)
+                except ValueError:
+                    continue   # dead fork below finality, duplicates
+
+    # -- tree bookkeeping -----------------------------------------------------
+    def head(self) -> Header:
+        return self.chain[-1]
+
+    def _index_header(self, header: Header) -> None:
+        h = header.hash()
+        self.headers[h] = header
+        self._primaries[h] = self._primaries[header.parent] \
+            + (1 if header.claim and header.claim.vrf is not None else 0)
+
+    def _weight(self, tip_hash: bytes) -> tuple[int, int]:
+        """Fork-choice weight: (height, cumulative primary slots).
+        Strictly-greater wins; ties keep the incumbent (deterministic
+        per node; the vote exchange settles cross-node ties)."""
+        return (self.headers[tip_hash].number, self._primaries[tip_hash])
+
+    def _is_canonical(self, h: bytes) -> bool:
+        header = self.headers.get(h)
+        return (header is not None and header.number < len(self.chain)
+                and self.chain[header.number].hash() == h)
+
+    def authorities_at(self, block_hash: bytes) -> tuple[str, ...]:
+        """The authority set in force for a child of ``block_hash``:
+        the set after applying that block, or (for stored-unexecuted
+        side-branch ancestors) the deepest executed ancestor's set.
+        Era rotation makes this branch-dependent — verifying a fork
+        block against the head's set would reject valid forks."""
+        cur = block_hash
+        while cur in self.headers:
+            got = self._authset.get(cur)
+            if got is not None:
+                return got
+            cur = self.headers[cur].parent
+        return self.authorities
 
     def _persist_block(self, block: Block) -> None:
         self.block_bodies[block.header.number] = block
@@ -97,19 +171,35 @@ class Node:
 
                 _store.write_snapshot(self.base_path, self)
 
+    # -- sync -----------------------------------------------------------------
     def sync_from(self, peer: "Node") -> int:
-        """Catch up missed blocks from a peer's served bodies (the
-        restart/warp-sync path, ref service.rs:259-274). Returns the
-        number of blocks imported."""
+        """Catch up from a peer's canonical chain (the restart/warp
+        sync path, ref service.rs:259-274). Finds the highest common
+        block, imports the peer's tail (fork choice decides whether to
+        adopt), then verifies + adopts the peer's justifications.
+        Returns the number of blocks imported."""
+        common = min(self.head().number, peer.head().number)
+        while self.chain[common].hash() != peer.chain[common].hash():
+            common -= 1
         imported = 0
-        while len(self.chain) <= peer.chain[-1].number:
-            body = peer.block_bodies.get(len(self.chain))
+        for n in range(common + 1, peer.head().number + 1):
+            body = peer.block_bodies.get(n)
             if body is None:
                 break
-            self.import_block(body)
+            try:
+                self.import_block(body)
+            except ValueError:
+                break
             imported += 1
-        self.finalized = max(self.finalized,
-                             min(peer.finalized, self.chain[-1].number))
+        if peer.finality.justifications:
+            # adopt the peer's newest justification (older rounds are
+            # implied: finalizing a block finalizes its ancestors)
+            rnd = max(peer.finality.justifications)
+            just = peer.finality.justifications[rnd]
+            if rnd > self.finalized \
+                    and self.finality.verify_justification(just):
+                self.finality.justifications[rnd] = just
+                self.on_justification(just)
         return imported
 
     # -- tx pool ---------------------------------------------------------------
@@ -142,8 +232,9 @@ class Node:
     def try_author(self, slot: int,
                    extrinsics: tuple | None = None) -> Block | None:
         """Claim the slot with any local authority key and build a block
-        as an OPEN PROPOSAL — the caller must commit_proposal() or
-        abort_proposal() (fork choice may prefer a peer's block).
+        on the current best head as an OPEN PROPOSAL — the caller must
+        commit_proposal() or abort_proposal() (fork choice may prefer a
+        peer's block).
 
         ``extrinsics``: the tx set to include (the Network hands every
         proposer the same gossip snapshot); standalone nodes default to
@@ -163,7 +254,7 @@ class Node:
             self.runtime.state.begin_tx()
             self._execute(claim, extrinsics)
             header = Header(number=len(self.chain),
-                            parent=self.chain[-1].hash(),
+                            parent=self.head().hash(),
                             state_root=self.runtime.state.state_root(),
                             author=account, claim=claim)
             self._proposal = (header, extrinsics, snapshot)
@@ -171,12 +262,11 @@ class Node:
         return None
 
     def commit_proposal(self) -> None:
-        header, extrinsics, _ = self._proposal
-        self.runtime.state.commit_tx()
+        header, extrinsics, (block0, events0) = self._proposal
+        undo = self.runtime.state.commit_tx_undo()
         self._proposal = None
-        self.chain.append(header)
-        self._persist_block(Block(header=header, extrinsics=extrinsics))
-        self._post_block(header.claim)
+        self._adopt_block(Block(header=header, extrinsics=extrinsics),
+                          undo, block0, events0, persist=True)
 
     def abort_proposal(self, requeue: bool = True) -> None:
         """Fork choice lost: roll the whole block back; re-queue txs
@@ -204,12 +294,34 @@ class Node:
                 self.runtime.state.deposit_event(
                     "system", "ExtrinsicFailed", call=call, error=e.name)
 
-    def _post_block(self, claim: SlotClaim) -> None:
+    def _adopt_block(self, block: Block, undo: list, block0: int,
+                     events0: list, persist: bool,
+                     fire_agents: bool = True) -> None:
+        """Append an EXECUTED block to the canonical chain, recording
+        its undo + consensus side effects for possible rewind."""
+        header = block.header
+        claim = header.claim
+        vrf_note = None
         if claim.vrf is not None:
+            epoch = self.rrsc.epoch_of(claim.slot)
             self.rrsc.note_vrf(claim.slot, claim.vrf.output)
+            vrf_note = (epoch, claim.vrf.output)
+        auth_before = self.authorities
+        self.chain.append(header)
+        self._index_header(header)
+        self.bodies[header.hash()] = block
+        self._undo[header.hash()] = _UndoRec(
+            state_undo=undo, block_before=block0, events_before=events0,
+            authorities_before=auth_before, vrf_note=vrf_note)
         self._maybe_rotate_session()
-        for agent in self.offchain_agents:
-            agent.on_block(self)
+        self._authset[header.hash()] = self.authorities
+        if persist:
+            self._persist_block(block)
+        else:
+            self.block_bodies[header.number] = block
+        if fire_agents:
+            for agent in self.offchain_agents:
+                agent.on_block(self)
 
     def _maybe_rotate_session(self) -> None:
         """Era boundary: credit-weighted election refreshes the
@@ -225,39 +337,177 @@ class Node:
 
     # -- import -------------------------------------------------------------------
     def import_block(self, block: Block, _persist: bool = True) -> None:
-        """Verify the claim, re-execute, check the state root."""
+        """Tree import: verify the claim; execute (re-deriving the
+        state root) when the block extends the best chain, store
+        side-branch blocks and reorg when their branch outweighs."""
         header = block.header
-        if header.number != len(self.chain):
-            raise ValueError(f"{self.name}: non-sequential import "
-                             f"{header.number} != {len(self.chain)}")
-        if header.parent != self.chain[-1].hash():
-            raise ValueError(f"{self.name}: parent hash mismatch")
+        h = header.hash()
+        if h in self.headers:
+            # duplicate (idempotent: gossip redelivers); re-register the
+            # body if we only held the header (snapshot-restored chain)
+            if h not in self.bodies:
+                self.bodies[h] = block
+                if self._is_canonical(h):
+                    self.block_bodies.setdefault(header.number, block)
+            return
+        parent = self.headers.get(header.parent)
+        if parent is None:
+            raise ValueError(f"{self.name}: unknown parent for "
+                             f"#{header.number}")
+        if header.number != parent.number + 1:
+            raise ValueError(f"{self.name}: number {header.number} does "
+                             f"not follow parent {parent.number}")
+        if header.number <= self.finalized:
+            raise ValueError(f"{self.name}: #{header.number} conflicts "
+                             f"with finality at #{self.finalized}")
         public = self.spec.session_key(header.author).public
-        if not self.rrsc.verify_claim(header.claim, public, self.authorities):
+        authorities = self.authorities_at(header.parent)
+        if not self.rrsc.verify_claim(header.claim, public, authorities):
             raise ValueError(f"{self.name}: bad slot claim")
-        self._execute(header.claim, block.extrinsics)
-        got = self.runtime.state.state_root()
-        if got != header.state_root:
-            raise ValueError(
-                f"{self.name}: state root mismatch at #{header.number} — "
-                "replicas diverged")
-        self.chain.append(header)
-        if _persist:
-            self._persist_block(block)
-        else:
-            self.block_bodies[header.number] = block
-        self._post_block(header.claim)
+        if header.parent == self.head().hash():
+            self._apply_to_head(block, persist=_persist)
+            return
+        # side branch: store, reorg if the branch now outweighs
+        self._index_header(header)
+        self.bodies[h] = block
+        if self._weight(h) > self._weight(self.head().hash()):
+            self._reorg_to(h, persist=_persist)
+
+    def _apply_to_head(self, block: Block, persist: bool,
+                       fire_agents: bool = True) -> None:
+        """Execute a block extending the current head; raises (with
+        full rollback) on state-root mismatch."""
+        state = self.runtime.state
+        snapshot = (state.block, list(state.events))
+        state.begin_tx()
+        try:
+            self._execute(block.header.claim, block.extrinsics)
+            got = state.state_root()
+            if got != block.header.state_root:
+                raise ValueError(
+                    f"{self.name}: state root mismatch at "
+                    f"#{block.header.number} — replicas diverged")
+        except Exception:
+            state.rollback_tx()
+            state.block = snapshot[0]
+            state.truncate_history(snapshot[0])
+            state.events[:] = snapshot[1]
+            raise
+        undo = state.commit_tx_undo()
+        self._adopt_block(block, undo, snapshot[0], snapshot[1],
+                          persist=persist, fire_agents=fire_agents)
+
+    # -- reorg --------------------------------------------------------------------
+    def _can_rewind_to(self, fork_number: int) -> bool:
+        """Every canonical block above the fork point must carry an
+        undo log (snapshot-restored blocks do not) — checked BEFORE
+        any rewind so a refused reorg leaves the node untouched."""
+        return all(self.chain[n].hash() in self._undo
+                   for n in range(fork_number + 1, len(self.chain)))
+
+    def _rewind_one(self) -> None:
+        head = self.chain[-1]
+        rec = self._undo.pop(head.hash(), None)
+        if rec is None:
+            # blocks restored from a snapshot carry no undo log —
+            # they are effectively final for this node
+            raise ValueError(f"{self.name}: cannot rewind #{head.number} "
+                             "(no undo log; snapshot-restored)")
+        self.chain.pop()
+        self._authset.pop(head.hash(), None)
+        state = self.runtime.state
+        state.apply_undo(rec.state_undo)
+        state.block = rec.block_before
+        state.truncate_history(rec.block_before)
+        state.events[:] = rec.events_before
+        self.authorities = rec.authorities_before
+        if rec.vrf_note is not None:
+            epoch, output = rec.vrf_note
+            outs = self.rrsc._epoch_vrf.get(epoch, [])
+            if output in outs:
+                outs.remove(output)
+            # later epoch randomness derived from these outputs is stale
+            for e in [e for e in self.rrsc.randomness if e > epoch]:
+                del self.rrsc.randomness[e]
+        self.block_bodies.pop(head.number, None)
+
+    def _branch_path(self, tip_hash: bytes) -> tuple[int, list[bytes]]:
+        """(fork_number, path tip->..->child-of-fork) back to the
+        canonical chain."""
+        path = []
+        cur = tip_hash
+        while not self._is_canonical(cur):
+            path.append(cur)
+            cur = self.headers[cur].parent
+        return self.headers[cur].number, path
+
+    def _reorg_to(self, tip_hash: bytes, persist: bool = True) -> None:
+        fork_number, path = self._branch_path(tip_hash)
+        if fork_number < self.finalized:
+            raise ValueError(f"{self.name}: reorg below finalized "
+                             f"#{self.finalized}")
+        if not self._can_rewind_to(fork_number):
+            raise ValueError(f"{self.name}: reorg to fork at "
+                             f"#{fork_number} crosses a snapshot "
+                             "boundary (no undo logs)")
+        old_tail = [self.block_bodies[n]
+                    for n in range(fork_number + 1, len(self.chain))]
+        while self.head().number > fork_number:
+            self._rewind_one()
+        try:
+            for i, h in enumerate(reversed(path)):
+                # agents fire once, on the new head, not per replayed block
+                self._apply_to_head(self.bodies[h], persist=persist,
+                                    fire_agents=(i == len(path) - 1))
+        except ValueError:
+            # losing branch was invalid after all: restore the old chain
+            while self.head().number > fork_number:
+                self._rewind_one()
+            for i, body in enumerate(old_tail):
+                self._apply_to_head(body, persist=False,
+                                    fire_agents=(i == len(old_tail) - 1))
+            raise
+        if old_tail:
+            self.tx_pool[:0] = [
+                xt for b in old_tail for xt in b.extrinsics
+                if not any(xt == kept
+                           for h2 in path
+                           for kept in self.bodies[h2].extrinsics)]
+
+    # -- finality -----------------------------------------------------------------
+    def on_justification(self, just: Justification) -> None:
+        """2/3 votes assembled (locally or from a peer): finalize —
+        forcing a reorg if the justified block is on a side branch."""
+        num = just.target_number
+        if num <= self.finalized:
+            return
+        if not self._is_canonical(just.target_hash):
+            if just.target_hash not in self.headers:
+                return   # unknown block; sync will fetch + re-apply
+            try:
+                self._reorg_to(just.target_hash)
+            except ValueError:
+                # pinned (snapshot boundary) or invalid branch: stay
+                # put; catch-up sync re-delivers once resolvable
+                return
+        prev = self.finalized
+        self.finalized = num
+        # undo logs at/below finality can never rewind: drop them
+        # (O(newly finalized), not O(chain))
+        for n in range(prev + 1, min(num + 1, len(self.chain))):
+            self._undo.pop(self.chain[n].hash(), None)
 
 
 class Network:
     """Drives slots across nodes: fork choice (primary beats secondary,
-    lowest VRF output wins ties), broadcast, 2/3 finality votes."""
+    lowest VRF output wins ties), broadcast, vote-based finality."""
 
     def __init__(self, nodes: list[Node]):
         self.nodes = nodes
         # tx gossip: one shared mempool (instant propagation); dedupe
         # by identity — nodes re-networked after a peer restart may
-        # already share one pool object
+        # already share one pool object. The socket transport
+        # (node/net.py) replaces this with real per-process pools.
         shared: list[SignedExtrinsic] = []
         seen: set[int] = set()
         for node in nodes:
@@ -298,21 +548,19 @@ class Network:
         for node in self.nodes:
             if node is not author_node:
                 node.import_block(best)
-        self._finalize(best.header)
+        self.exchange_votes()
         return best
 
-    def _finalize(self, header: Header) -> None:
-        """GRANDPA-lite: every authority on every node votes for the
-        imported head; 2/3 finalizes."""
-        votes = set()
+    def exchange_votes(self) -> None:
+        """The GRANDPA-gossip analog: every node casts signed votes
+        for its best chain and every vote reaches every node; each
+        node tallies + finalizes independently."""
+        votes = []
         for node in self.nodes:
-            for account in node.keystore:
-                if account in node.authorities:
-                    votes.add(account)
-        n_auth = len(self.nodes[0].authorities)
-        if 3 * len(votes) >= 2 * n_auth:
-            for node in self.nodes:
-                node.finalized = header.number
+            votes.extend(node.finality.cast_votes())
+        for node in self.nodes:
+            for v in votes:
+                node.finality.on_vote(v)
 
     def run_slots(self, count: int) -> None:
         start = max(len(n.chain) for n in self.nodes)

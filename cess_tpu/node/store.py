@@ -104,6 +104,7 @@ def write_snapshot(base_path: str, node) -> None:
         node.rrsc._epoch_vrf,
         tuple(node.authorities),
         node.finalized,
+        dict(node.finality.justifications),
     ))
     tmp = os.path.join(base_path, SNAPSHOT_FILE + ".tmp")
     with open(tmp, "wb") as f:
@@ -126,7 +127,7 @@ def load_snapshot(base_path: str, node) -> bool:
         return False
     try:
         (chain, kv, block, randomness, epoch_vrf, authorities,
-         finalized) = codec.decode(raw[len(_MAGIC):])
+         finalized, justifications) = codec.decode(raw[len(_MAGIC):])
     except (codec.CodecError, ValueError):
         return False
     state = node.runtime.state
@@ -143,8 +144,22 @@ def load_snapshot(base_path: str, node) -> bool:
         state.rebuild_root_cache()
         return False
     node.chain = list(chain)
+    # rebuild the block-tree index for the canonical chain (bodies are
+    # re-registered when the block-log replay re-imports them); no undo
+    # logs survive a restart, so snapshot blocks cannot be rewound
+    node.headers = {}
+    node._primaries = {}
+    node._undo = {}
+    prev_primaries = 0
+    for hd in node.chain:
+        h = hd.hash()
+        node.headers[h] = hd
+        prev_primaries += 1 if (hd.claim and hd.claim.vrf) else 0
+        node._primaries[h] = prev_primaries
     node.rrsc.randomness = {int(k): v for k, v in randomness.items()}
     node.rrsc._epoch_vrf = {int(k): list(v) for k, v in epoch_vrf.items()}
     node.authorities = tuple(authorities)
     node.finalized = finalized
+    node.finality.justifications = {int(k): v
+                                    for k, v in justifications.items()}
     return True
