@@ -1,0 +1,114 @@
+"""EVM / contracts capability boundary (Frontier stub).
+
+The reference embeds the Frontier EVM stack + Wasm contracts
+(/root/reference/runtime/src/lib.rs:1524-1528: Contracts, Ethereum,
+EVM, DynamicFee, BaseFee; node-side Frontier DB + RPC workers,
+node/src/service.rs:56-81,392-429). SURVEY.md §2.3 scopes this as
+"port as optional module or stub behind the same API boundary" — out
+of the TPU hot path.
+
+This module IS that boundary: the dispatch surface (deploy / call /
+query / account basics) exists with the reference's shape, maintains
+EVM account + code storage, and executes a deliberately minimal
+subset; anything beyond it fails with ``evm.NotSupported`` — a typed
+capability refusal, not an AttributeError. A full interpreter (or a
+bridge) slots in behind this exact surface without touching callers.
+
+Supported today: code storage/retrieval, balance transfers into/out of
+the EVM domain (the pallet-evm withdraw/deposit analog), and STOP/
+RETURN-of-calldata bytecode (enough to round-trip deploy->call->query
+in tests). Everything else: NotSupported.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from .state import DispatchError, State
+
+PALLET = "evm"
+
+# one-byte "opcodes" of the minimal executable subset
+OP_STOP = 0x00
+OP_ECHO = 0xFE   # returns calldata (test/diagnostic contract)
+
+
+class Evm:
+    def __init__(self, state: State, balances):
+        self.state = state
+        self.balances = balances
+
+    # -- accounts (pallet-evm deposit/withdraw analog) -----------------------
+    def deposit(self, who: str, amount: int) -> None:
+        """Move native balance into the EVM domain ledger."""
+        if not isinstance(amount, int) or amount <= 0:
+            raise DispatchError("evm.InvalidAmount")
+        self.balances.reserve(who, amount)
+        bal = self.state.get(PALLET, "balance", who, default=0)
+        self.state.put(PALLET, "balance", who, bal + amount)
+        self.state.deposit_event(PALLET, "Deposited", who=who,
+                                 amount=amount)
+
+    def withdraw(self, who: str, amount: int) -> None:
+        bal = self.state.get(PALLET, "balance", who, default=0)
+        if not isinstance(amount, int) or amount <= 0 or amount > bal:
+            raise DispatchError("evm.InvalidAmount")
+        self.state.put(PALLET, "balance", who, bal - amount)
+        self.balances.unreserve(who, amount)
+        self.state.deposit_event(PALLET, "Withdrawn", who=who,
+                                 amount=amount)
+
+    def balance(self, who: str) -> int:
+        return self.state.get(PALLET, "balance", who, default=0)
+
+    # -- contracts -----------------------------------------------------------
+    def deploy(self, who: str, code: bytes) -> bytes:
+        """Store contract code; returns the contract address
+        (CREATE-address analog: hash of deployer + nonce)."""
+        if not isinstance(code, bytes) or not code:
+            raise DispatchError("evm.InvalidCode")
+        nonce = self.state.get(PALLET, "nonce", who, default=0)
+        self.state.put(PALLET, "nonce", who, nonce + 1)
+        addr = hashlib.sha256(b"evm-create:" + who.encode()
+                              + nonce.to_bytes(8, "little")).digest()[:20]
+        self.state.put(PALLET, "code", addr, code)
+        self.state.deposit_event(PALLET, "Deployed", who=who,
+                                 address=addr, code_len=len(code))
+        return addr
+
+    def code_at(self, address: bytes) -> bytes | None:
+        return self.state.get(PALLET, "code", address)
+
+    def call(self, who: str, address: bytes, calldata: bytes) -> bytes:
+        """Execute a contract call. Only the minimal subset runs;
+        real bytecode gets the typed capability refusal."""
+        code = self.code_at(address)
+        if code is None:
+            raise DispatchError("evm.NoContract")
+        if not isinstance(calldata, bytes):
+            raise DispatchError("evm.InvalidCall")
+        op = code[0]
+        if op == OP_STOP:
+            out = b""
+        elif op == OP_ECHO:
+            out = calldata
+        else:
+            raise DispatchError(
+                "evm.NotSupported",
+                f"opcode 0x{op:02x}: full EVM execution is behind this "
+                "boundary but not implemented")
+        self.state.deposit_event(PALLET, "Called", who=who,
+                                 address=address, out_len=len(out))
+        return out
+
+    def query(self, address: bytes, calldata: bytes) -> bytes:
+        """Read-only call (eth_call analog): same execution surface,
+        no events, no state writes committed by the caller."""
+        code = self.code_at(address)
+        if code is None:
+            raise DispatchError("evm.NoContract")
+        if code[0] == OP_STOP:
+            return b""
+        if code[0] == OP_ECHO:
+            return calldata
+        raise DispatchError("evm.NotSupported",
+                            f"opcode 0x{code[0]:02x}")

@@ -1,0 +1,93 @@
+"""Runtime versioning + StorageVersion-gated migrations.
+
+The reference stamps the runtime with ``spec_version: 109``
+(/root/reference/runtime/src/lib.rs:173) and migrates pallet storage
+through ``StorageVersion`` gates in on_runtime_upgrade
+(c-pallets/audit/src/migrations.rs:29-40: run only when the on-chain
+version is behind, transform entries, bump the version). Same design
+here: each pallet has an on-chain storage version; registered
+migrations run INSIDE block execution at the first block authored by
+upgraded code (deterministic across replicas, part of the state root
+like the reference's runtime-upgrade block), then bump versions.
+
+Real migrations in the registry (round-2 -> round-3 format changes):
+- staking v1 -> v2: validators gained ValidatorPrefs (commission);
+  pre-existing validators get the default 0 entry.
+- tee_worker v1 -> v2: pinned attestation signers changed from
+  32-byte key FINGERPRINTS to full RsaPublicKey roots (fingerprints
+  cannot verify cert chains and cannot be inverted) — stale-format
+  pins are dropped and must be re-pinned by governance.
+"""
+from __future__ import annotations
+
+from .state import State
+
+SPEC_VERSION = 110   # reference snapshot is 109 (runtime/src/lib.rs:173)
+
+SYSTEM = "system"
+
+
+def spec_version(state: State) -> int:
+    return state.get(SYSTEM, "spec_version", default=0)
+
+
+def storage_version(state: State, pallet: str) -> int:
+    return state.get(SYSTEM, "storage_version", pallet, default=1)
+
+
+def _migrate_staking_v2(state: State) -> int:
+    """Backfill ValidatorPrefs (commission=0) for existing validators."""
+    n = 0
+    for v in state.get("staking", "validators", default=()):
+        if not state.contains("staking", "prefs", v):
+            state.put("staking", "prefs", v, 0)
+            n += 1
+    return n
+
+
+def _migrate_tee_worker_v2(state: State) -> int:
+    """Drop fingerprint-format (bytes) attestation pins; structured
+    chain verification needs full root keys, re-pinned by governance."""
+    from ..crypto.rsa import RsaPublicKey
+
+    pins = state.get("tee_worker", "ias_pins", default=())
+    kept = tuple(p for p in pins if isinstance(p, RsaPublicKey))
+    if kept != pins:
+        state.put("tee_worker", "ias_pins", kept)
+    return len(pins) - len(kept)
+
+
+# (pallet, target_version, fn) — fn returns #entries transformed
+MIGRATIONS = [
+    ("staking", 2, _migrate_staking_v2),
+    ("tee_worker", 2, _migrate_tee_worker_v2),
+]
+
+
+def current_versions() -> dict[str, int]:
+    out: dict[str, int] = {}
+    for pallet, target, _ in MIGRATIONS:
+        out[pallet] = max(out.get(pallet, 1), target)
+    return out
+
+
+def stamp_genesis(state: State) -> None:
+    """Fresh chains start at current versions (no migration needed)."""
+    state.put(SYSTEM, "spec_version", SPEC_VERSION)
+    for pallet, version in current_versions().items():
+        state.put(SYSTEM, "storage_version", pallet, version)
+
+
+def run_pending(state: State) -> list[str]:
+    """on_runtime_upgrade analog: run every migration whose pallet
+    storage version is behind; bump versions + spec_version. Returns
+    the applied migration names (events are the caller's job)."""
+    applied = []
+    for pallet, target, fn in MIGRATIONS:
+        if storage_version(state, pallet) < target:
+            n = fn(state)
+            state.put(SYSTEM, "storage_version", pallet, target)
+            applied.append(f"{pallet}-v{target}({n})")
+    if spec_version(state) < SPEC_VERSION:
+        state.put(SYSTEM, "spec_version", SPEC_VERSION)
+    return applied

@@ -21,8 +21,12 @@ from .. import constants
 from .audit import Audit
 from .balances import Balances
 from .cacher import Cacher
+from .evm import Evm
 from .extrinsic import SignedExtrinsic, verify_signature
 from .file_bank import FileBank
+from .governance import Council, Treasury
+from .im_online import ImOnline
+from . import migrations
 from .offences import Offences
 from .oss import Oss
 from .scheduler import Scheduler
@@ -45,6 +49,7 @@ ROOT_ONLY = {
     "tee_worker.update_whitelist",
     "tee_worker.pin_ias_signer",
     "audit.set_keys",
+    "council.set_members",
 }
 
 # the dispatch surface — FRAME's #[pallet::call] analog. Pallet
@@ -62,6 +67,11 @@ SIGNED_CALLS = {
     "oss.authorize", "oss.cancel_authorize",
     "cacher.register", "cacher.update", "cacher.logout", "cacher.pay",
     "staking.bond", "staking.unbond", "staking.validate", "staking.chill",
+    "staking.nominate",
+    "im_online.heartbeat",
+    "council.propose", "council.vote", "council.close",
+    "treasury.propose_spend",
+    "evm.deposit", "evm.withdraw", "evm.deploy", "evm.call",
     "tee_worker.register", "tee_worker.exit",
     "file_bank.create_bucket", "file_bank.delete_bucket",
     "file_bank.upload_declaration", "file_bank.transfer_report",
@@ -88,6 +98,8 @@ FEELESS = {
     # evidence-carrying, self-validating (ref submits equivocation
     # reports as validated unsigned transactions)
     "offences.report_equivocation",
+    # ref im-online heartbeats are validated unsigned operational txs
+    "im_online.heartbeat",
 }
 
 
@@ -117,6 +129,7 @@ class Runtime:
         self.tee_worker = TeeWorker(s, staking=self.staking,
                                     credit=self.credit)
         self.offences = Offences(s, self.staking, self.genesis_hash)
+        self.im_online = ImOnline(s, self.staking, self.offences)
         self.file_bank = FileBank(s, self.balances, self.storage_handler,
                                   self.sminer, self.scheduler,
                                   fragment_count=self.config.fragment_count,
@@ -145,7 +158,17 @@ class Runtime:
             "file_bank": self.file_bank,
             "audit": self.audit,
             "offences": self.offences,
+            "im_online": self.im_online,
         }
+        self.treasury_pallet = Treasury(s, self.balances)
+        self.council = Council(s, self)   # needs self.pallets at close()
+        self.pallets["treasury"] = self.treasury_pallet
+        self.pallets["council"] = self.council
+        self.evm = Evm(s, self.balances)
+        self.pallets["evm"] = self.evm
+        # fresh chain: stamp current spec/storage versions (snapshots
+        # from older code trigger run_pending at the next init_block)
+        migrations.stamp_genesis(s)
         self._update_randomness()
 
     # -- dispatch --------------------------------------------------------------
@@ -297,6 +320,13 @@ class Runtime:
         self.state.archive_events()
         self.state.block += 1
         self.state.put("system", "author", author)
+        # on_runtime_upgrade analog: first block authored by upgraded
+        # code runs pending StorageVersion migrations inside block
+        # execution (deterministic, part of the state root)
+        if migrations.spec_version(self.state) < migrations.SPEC_VERSION:
+            for name in migrations.run_pending(self.state):
+                self.state.deposit_event("system", "MigrationApplied",
+                                         migration=name)
         if randomness is not None:
             self.set_randomness(randomness)
         else:
@@ -307,7 +337,10 @@ class Runtime:
         self.credit.on_initialize()
         if self.state.block % self.config.era_blocks == 0:
             era = self.staking.current_era()
+            self.im_online.era_check(era)
             self.staking.end_era(era)
+            self.treasury_pallet.on_spend_period()
+            self.staking.capture_exposures(era + 1)
             self.sminer.release_reward_tranches()
             # session rotation: audit keys follow the elected set
             elected = self.staking.electable()

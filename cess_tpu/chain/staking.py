@@ -9,13 +9,21 @@ Mirrors /root/reference/c-pallets/staking/src/: reward schedule
 pallet/impls.rs:452-474, end_era sminer issuance :430-449,
 slash_scheduler slashing.rs:694-705, config runtime/src/lib.rs:585-589.
 
-Nominator/era-exposure machinery is intentionally collapsed to
-validator self-bonds; the election itself is credit-weighted and lives
-in cess_tpu/node/consensus.py (the reference's VrfSolver).
+Nominators: the CESS runtime pins ``MaxNominations = 1``
+(runtime/src/lib.rs:378), so a nominator backs exactly one validator
+with their whole bond. Era exposure (own + nominator bonds) is
+captured at era START and drives both the era payout split (validator
+commission off the top, remainder exposure-pro-rata,
+pallet/impls.rs era payout) and offence slashing (validator AND
+exposed nominators slashed at the offence fraction). The election
+itself is credit-weighted and lives in cess_tpu/node/consensus.py
+(the reference's VrfSolver).
 """
 from __future__ import annotations
 
-from .. import constants
+import dataclasses
+
+from .. import codec, constants
 from .balances import Balances
 from .sminer import REWARD_POOL
 from .state import DispatchError, State
@@ -24,7 +32,18 @@ PALLET = "staking"
 TREASURY = "treasury"
 
 MIN_VALIDATOR_BOND = 1_000_000 * constants.DOLLARS   # runtime :585-589
+MIN_NOMINATOR_BOND = 1_000 * constants.DOLLARS       # genesis min_nominator_bond analog (pallet/mod.rs:313,638)
 ERAS_PER_YEAR = 365 * 4   # 6-hour eras (1h epochs x 6 sessions)
+
+
+@codec.register
+@dataclasses.dataclass(frozen=True)
+class Exposure:
+    """Who backs a validator for one era (Substrate's Exposure)."""
+
+    own: int
+    nominators: tuple[tuple[str, int], ...]
+    total: int
 
 
 class Staking:
@@ -53,22 +72,76 @@ class Staking:
     def bonded(self, who: str) -> int:
         return self.state.get(PALLET, "bond", who, default=0)
 
-    def validate(self, who: str) -> None:
-        """Declare validator intent (needs MinValidatorBond)."""
+    def validate(self, who: str, commission_permill: int = 0) -> None:
+        """Declare validator intent (needs MinValidatorBond) with
+        commission prefs (ValidatorPrefs, pallet/mod.rs:1111-1137)."""
         if self.bonded(who) < MIN_VALIDATOR_BOND:
             raise DispatchError("staking.InsufficientBond")
+        if not isinstance(commission_permill, int) \
+                or not 0 <= commission_permill <= 1000:
+            raise DispatchError("staking.InvalidCommission")
+        self.state.put(PALLET, "prefs", who, commission_permill)
+        # a validator cannot simultaneously nominate: its bond would be
+        # exposed twice (own + as someone's backer)
+        self.state.delete(PALLET, "nomination", who)
         vals = self.validators()
         if who not in vals:
             self.state.put(PALLET, "validators", vals + (who,))
 
+    def commission(self, who: str) -> int:
+        return self.state.get(PALLET, "prefs", who, default=0)
+
     def chill(self, who: str) -> None:
+        """Drop validator intent AND any nomination (Substrate chill)."""
         vals = self.validators()
         if who in vals:
             self.state.put(PALLET, "validators",
                            tuple(v for v in vals if v != who))
+        self.state.delete(PALLET, "nomination", who)
 
     def validators(self) -> tuple[str, ...]:
         return self.state.get(PALLET, "validators", default=())
+
+    # -- nominations (MaxNominations = 1, runtime/src/lib.rs:378) ---------------
+    def nominate(self, who: str, target: str) -> None:
+        if self.bonded(who) < MIN_NOMINATOR_BOND:
+            raise DispatchError("staking.InsufficientBond",
+                                "below MinNominatorBond")
+        if target not in self.validators():
+            raise DispatchError("staking.NotValidator", target)
+        if who in self.validators():
+            raise DispatchError("staking.AlreadyValidating", who)
+        self.state.put(PALLET, "nomination", who, target)
+        self.state.deposit_event(PALLET, "Nominated", who=who,
+                                 target=target)
+
+    def nomination(self, who: str) -> str | None:
+        return self.state.get(PALLET, "nomination", who)
+
+    def nominators_of(self, target: str) -> list[tuple[str, int]]:
+        return sorted((n[0], self.bonded(n[0]))
+                      for n, t in self.state.iter_prefix(PALLET,
+                                                         "nomination")
+                      if t == target)
+
+    # -- era exposure -----------------------------------------------------------
+    def capture_exposures(self, era: int) -> None:
+        """Era start: freeze who backs whom with how much; the era's
+        payout and any offence slashing use THIS snapshot, immune to
+        post-hoc bond shuffling (ErasStakers, pallet/mod.rs:344-460)."""
+        for v in (self.electable() or list(self.validators())):
+            noms = tuple(self.nominators_of(v))
+            own = self.bonded(v)
+            self.state.put(PALLET, "exposure", era, v, Exposure(
+                own=own, nominators=noms,
+                total=own + sum(a for _, a in noms)))
+
+    def exposure(self, era: int, validator: str) -> Exposure | None:
+        return self.state.get(PALLET, "exposure", era, validator)
+
+    def era_validators(self, era: int) -> list[str]:
+        return [k[0] for k, _ in self.state.iter_prefix(PALLET,
+                                                        "exposure", era)]
 
     def electable(self) -> list[str]:
         """Stake floor for election: MIN_ELECTABLE_STAKE = 3M DOLLARS
@@ -91,19 +164,41 @@ class Staking:
         return v, s
 
     def end_era(self, era_index: int) -> None:
-        """Mint the era's issuance: validator share pro-rata by bond,
+        """Mint the era's issuance: validator share split by era
+        exposure (commission off the top, remainder exposure-pro-rata
+        across own + nominator stakes — Substrate's payout shape),
         sminer share into the reward pool."""
         year = era_index // ERAS_PER_YEAR
         v_year, s_year = self.rewards_in_year(year)
         v_era = v_year // ERAS_PER_YEAR
         s_era = s_year // ERAS_PER_YEAR
         self.balances.mint(REWARD_POOL, s_era)
-        active = self.electable() or list(self.validators())
-        total_bond = sum(self.bonded(v) for v in active)
-        if total_bond > 0:
-            for v in active:
-                share = v_era * self.bonded(v) // total_bond
-                self.balances.mint(v, share)
+        exposed = self.era_validators(era_index)
+        if exposed:
+            stakes = {v: self.exposure(era_index, v) for v in exposed}
+            grand = sum(e.total for e in stakes.values())
+            for v in sorted(exposed):
+                e = stakes[v]
+                if grand <= 0 or e.total <= 0:
+                    continue
+                pot = v_era * e.total // grand
+                fee = pot * self.commission(v) // 1000
+                rest = pot - fee
+                self.balances.mint(v, fee + rest * e.own // e.total)
+                for nom, amount in e.nominators:
+                    self.balances.mint(nom, rest * amount // e.total)
+        else:
+            # genesis era: no exposure snapshot yet; split by own bond
+            active = self.electable() or list(self.validators())
+            total_bond = sum(self.bonded(v) for v in active)
+            if total_bond > 0:
+                for v in active:
+                    self.balances.mint(v, v_era * self.bonded(v)
+                                       // total_bond)
+        # exposures two eras back can no longer be paid or slashed here
+        for (e, v), _ in list(self.state.iter_prefix(PALLET, "exposure")):
+            if e < era_index - 1:
+                self.state.delete(PALLET, "exposure", e, v)
         self.state.put(PALLET, "era", era_index + 1)
         self.state.deposit_event(PALLET, "EraPaid", era=era_index,
                                  validator_payout=v_era, sminer_payout=s_era)
@@ -112,11 +207,7 @@ class Staking:
         return self.state.get(PALLET, "era", default=0)
 
     # -- offence slashing ---------------------------------------------------------
-    def slash_fraction(self, who: str, permill: int) -> int:
-        """Slash ``permill``/1000 of the current bond to treasury
-        (consensus-fault punishment; the reference routes offences
-        through pallet-staking's slashing machinery). Returns the
-        amount taken."""
+    def _slash_one(self, who: str, permill: int) -> int:
         b = self.bonded(who)
         taken = b * permill // 1000
         if taken:
@@ -124,6 +215,36 @@ class Staking:
             self.balances.slash_reserved(who, taken, TREASURY)
         self.state.deposit_event(PALLET, "Slashed", who=who, amount=taken,
                                  permill=permill)
+        return taken
+
+    def _slash_amount(self, who: str, amount: int) -> int:
+        """Take up to ``amount`` from the bond (exposure-based slash:
+        the EXPOSED stake is liable, capped by what is still bonded)."""
+        b = self.bonded(who)
+        taken = min(b, amount)
+        if taken:
+            self.state.put(PALLET, "bond", who, b - taken)
+            self.balances.slash_reserved(who, taken, TREASURY)
+        self.state.deposit_event(PALLET, "Slashed", who=who, amount=taken,
+                                 permill=0)
+        return taken
+
+    def slash_fraction(self, who: str, permill: int) -> int:
+        """Slash ``permill``/1000 of the offender's ERA EXPOSURE — own
+        stake and every exposed nominator (Substrate slashes the
+        offending era's exposure, so post-offence unbonding cannot
+        dodge it beyond what already left the bond). Falls back to the
+        live bond when no exposure snapshot exists. Returns the total
+        taken."""
+        e = self.exposure(self.current_era(), who)
+        if e is None:
+            taken = self._slash_one(who, permill)
+            for nom, amount in self.nominators_of(who):
+                taken += self._slash_amount(nom, amount * permill // 1000)
+            return taken
+        taken = self._slash_amount(who, e.own * permill // 1000)
+        for nom, amount in e.nominators:
+            taken += self._slash_amount(nom, amount * permill // 1000)
         return taken
 
     # -- scheduler slash (slashing.rs:694-705) ------------------------------------

@@ -64,6 +64,13 @@ class System:
     def set_sudo(self, who: str | None) -> None:
         self.state.put(PALLET, "sudo", who)
 
+    def retire_sudo(self) -> None:
+        """Permanently clear the sudo key (council-motion-only; the
+        chain's bootstrap->collective-control transition, the
+        reference's sudo removal path)."""
+        self.state.put(PALLET, "sudo", None)
+        self.state.deposit_event(PALLET, "SudoRetired")
+
     # -- misc ------------------------------------------------------------------
     def remark(self, who: str, data: bytes) -> None:
         self.state.deposit_event(PALLET, "Remark", who=who, size=len(data))

@@ -10,18 +10,22 @@ Mirrors /root/reference/c-pallets/tee-worker/src/lib.rs: register
 TeePodr2Pk :122-123, update_whitelist :210-218, ScheduleFind incl.
 punish_scheduler :294-321.
 
-Attestation format here: (payload, signature, signer_pubkey) where the
-signature must verify over payload with an RSA key whose fingerprint is
-in the pinned signer set (standing in for the pinned IAS root chain),
-and payload must embed the whitelisted MRENCLAVE and the registered
-PoDR2 key (binding the key to the enclave).
+Attestation: a STRUCTURED report + signer certificate chain
+(cess_tpu/chain/attestation.py) — the report is parsed, its
+report_data must equal the (podr2_pk, controller) binding, its
+MRENCLAVE must be whitelisted, and the signing cert must chain to a
+root pinned on chain — mirroring the reference's webpki chain
+verification + fixed-offset quote parsing
+(primitives/enclave-verify/src/lib.rs:46-219).
 """
 from __future__ import annotations
 
 import dataclasses
 
-from ..crypto.rsa import RsaPublicKey, rsa_verify_pkcs1v15
+from ..crypto.rsa import RsaPublicKey
 from .. import codec
+from .attestation import (AttestationReport, SignerCert,
+                          report_data_binding, verify_attestation)
 from .state import DispatchError, State
 
 PALLET = "tee_worker"
@@ -50,28 +54,31 @@ class TeeWorker:
             self.state.put(PALLET, "whitelist", wl + (mrenclave,))
 
     def pin_ias_signer(self, key: RsaPublicKey) -> None:
-        """Root: pin an attestation signer (the IAS root stand-in)."""
+        """Root: pin an attestation ROOT key (the IAS root CA analog;
+        cert chains must terminate here)."""
+        if not isinstance(key, RsaPublicKey):
+            raise DispatchError("tee_worker.BadRootKey")
         pins = self.state.get(PALLET, "ias_pins", default=())
-        self.state.put(PALLET, "ias_pins", pins + (key.fingerprint(),))
+        if key not in pins:
+            self.state.put(PALLET, "ias_pins", pins + (key,))
 
     # -- registration (lib.rs:138-177) ----------------------------------------
     def register(self, controller: str, stash: str, peer_id: bytes,
-                 podr2_pk: bytes, payload: bytes, signature: bytes,
-                 signer: RsaPublicKey) -> None:
+                 podr2_pk: bytes, report: AttestationReport,
+                 report_sig: bytes,
+                 cert_chain: tuple[SignerCert, ...]) -> None:
         if self.state.contains(PALLET, "worker", controller):
             raise DispatchError("tee_worker.Registered")
-        if signer.fingerprint() not in self.state.get(PALLET, "ias_pins",
-                                                      default=()):
-            raise DispatchError("tee_worker.UntrustedSigner")
-        if not rsa_verify_pkcs1v15(signer, payload, signature):
-            raise DispatchError("tee_worker.VerifyCertFailed")
+        roots = self.state.get(PALLET, "ias_pins", default=())
+        verify_attestation(roots, cert_chain, report, report_sig)
         wl = self.state.get(PALLET, "whitelist", default=())
-        if not any(mr in payload for mr in wl):
+        if report.mrenclave not in wl:   # parsed field, exact match
             raise DispatchError("tee_worker.NonTeeWorker",
                                 "MRENCLAVE not whitelisted")
-        if podr2_pk not in payload:
+        if report.report_data != report_data_binding(podr2_pk, controller):
             raise DispatchError("tee_worker.VerifyCertFailed",
-                                "podr2 key not bound in report")
+                                "report_data does not bind podr2_pk"
+                                " + controller")
         self.state.put(PALLET, "worker", controller, TeeWorkerInfo(
             controller=controller, stash=stash, peer_id=peer_id,
             podr2_pk=podr2_pk))

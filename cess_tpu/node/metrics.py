@@ -1,0 +1,85 @@
+"""Observability: Prometheus-style metrics + structured block logs.
+
+The reference threads a Prometheus registry through tx-pool, consensus
+and RPC and streams telemetry
+(/root/reference/node/src/service.rs:109-151,227-234). Here the same
+operational signals, framework-native:
+
+- ``render_metrics(node)``: Prometheus text exposition of chain
+  height / finality / tx pool / storage economy / audit state —
+  served at ``GET /metrics`` by the RPC server and by the TCP
+  service's status surface.
+- ``BlockLogger``: structured per-block JSON lines (the
+  ``log::info!`` + telemetry analog), attachable as an offchain
+  agent.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def collect(node) -> dict[str, float]:
+    rt = node.runtime
+    st = rt.state
+    ch = rt.audit.challenge()
+    m = {
+        "cess_block_height": node.head().number,
+        "cess_finalized_height": node.finalized,
+        "cess_tx_pool_size": len(node.tx_pool),
+        "cess_known_blocks": len(node.headers),
+        "cess_authorities": len(node.authorities),
+        "cess_spec_version": st.get("system", "spec_version", default=0),
+        "cess_era": rt.staking.current_era(),
+        "cess_total_idle_space_bytes":
+            rt.storage_handler.total_idle_space(),
+        "cess_total_service_space_bytes":
+            rt.storage_handler.total_service_space(),
+        "cess_miner_count": st.count_prefix("sminer", "miner"),
+        "cess_tee_worker_count": st.count_prefix("tee_worker", "worker"),
+        "cess_challenge_active": 0 if ch is None else 1,
+        "cess_challenge_pending_miners":
+            0 if ch is None else len(ch.miners),
+    }
+    # event-derived counters over the retained history window
+    verifies = st.events_of("audit", "VerifyResult")
+    m["cess_audit_pass_total"] = sum(
+        1 for e in verifies
+        if dict(e.data).get("idle") and dict(e.data).get("service"))
+    m["cess_audit_fail_total"] = len(verifies) - m["cess_audit_pass_total"]
+    m["cess_offences_total"] = len(st.events_of("offences"))
+    m["cess_extrinsic_failed_total"] = len(
+        st.events_of("system", "ExtrinsicFailed"))
+    return m
+
+
+def render_metrics(node) -> str:
+    """Prometheus text exposition format 0.0.4."""
+    lines = []
+    for name, value in sorted(collect(node).items()):
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class BlockLogger:
+    """Offchain-agent-shaped structured logger: one JSON line per
+    imported/authored block (height, hash, author, events, pool)."""
+
+    def __init__(self, stream=None):
+        self.stream = stream or sys.stderr
+
+    def on_block(self, node) -> None:
+        head = node.head()
+        rec = {
+            "ts": round(time.time(), 3),
+            "node": node.name,
+            "block": head.number,
+            "hash": head.hash().hex()[:16],
+            "author": head.author,
+            "finalized": node.finalized,
+            "events": len(node.runtime.state.events),
+            "tx_pool": len(node.tx_pool),
+        }
+        print(json.dumps(rec), file=self.stream, flush=True)

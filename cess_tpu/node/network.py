@@ -518,9 +518,30 @@ class Network:
         for node in nodes:
             node.tx_pool = shared
 
+    def _queue_heartbeats(self) -> None:
+        """The im-online OCW analog: each node queues one heartbeat
+        per era for every local authority key (a node that is down
+        queues nothing and is reported at era end)."""
+        for node in self.nodes:
+            era = node.runtime.staking.current_era()
+            pool = node.tx_pool
+            for account in node.keystore:
+                if account not in node.authorities:
+                    continue
+                if node.runtime.im_online.has_beat(era, account):
+                    continue
+                if any(t.call == "im_online.heartbeat"
+                       and t.signer == account for t in pool):
+                    continue
+                try:
+                    node.submit_extrinsic(account, "im_online.heartbeat")
+                except DispatchError:
+                    pass
+
     def run_slot(self, slot: int) -> Block | None:
         """Authors race; fork choice = primary beats secondary, then
         lowest VRF output; losers roll back and re-import the winner."""
+        self._queue_heartbeats()
         txs = tuple(self.nodes[0].tx_pool)   # one gossip snapshot for all
         candidates: list[tuple[int, bytes, Node, Block]] = []
         for node in self.nodes:

@@ -57,6 +57,21 @@ class RpcServer:
             def log_message(self, *args):  # quiet
                 pass
 
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                from .metrics import render_metrics
+
+                data = render_metrics(server.node).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 try:
@@ -134,4 +149,15 @@ class RpcServer:
             return rt.file_bank.file(_decode(params[0]))
         if method == "cess_challenge":
             return rt.audit.challenge()
+        if method == "system_version":
+            from ..chain import migrations as _mig
+
+            return {"specVersion": _mig.spec_version(rt.state),
+                    "storageVersions": {
+                        p: _mig.storage_version(rt.state, p)
+                        for p in sorted({m[0] for m in _mig.MIGRATIONS})}}
+        if method == "system_metrics":
+            from .metrics import collect
+
+            return collect(node)
         raise ValueError(f"unknown method {method!r}")

@@ -225,20 +225,22 @@ def test_ownership_transfer(rt):
 # -- audit ---------------------------------------------------------------------
 
 def setup_tee(rt, controller="tee1", stash="stash1"):
+    from cess_tpu.chain.attestation import issue_cert, issue_report
     from cess_tpu.crypto.rsa import generate_rsa_keypair
 
-    kp = generate_rsa_keypair(1024, seed=1)
+    root_kp = generate_rsa_keypair(1024, seed=1)
+    signer_kp = generate_rsa_keypair(1024, seed=2)
     rt.fund(stash, 3_000_000 * D)
     rt.apply_extrinsic(stash, "staking.bond", 2_000_000 * D)
-    mrenclave = b"enclave-measure-1"
+    mrenclave = b"\x01" * 32
     rt.apply_extrinsic("root", "tee_worker.update_whitelist", mrenclave)
-    rt.apply_extrinsic("root", "tee_worker.pin_ias_signer", kp.public)
+    rt.apply_extrinsic("root", "tee_worker.pin_ias_signer", root_kp.public)
     podr2_pk = b"podr2-public-key"
-    payload = b"report:" + mrenclave + b":" + podr2_pk
-    sig = kp.sign_pkcs1v15(payload)
+    cert = issue_cert(root_kp, "ias-report-signer", signer_kp.public)
+    report, sig = issue_report(signer_kp, mrenclave, podr2_pk, controller)
     rt.apply_extrinsic(controller, "tee_worker.register", stash,
-                       b"teepeer", podr2_pk, payload, sig, kp.public)
-    return kp
+                       b"teepeer", podr2_pk, report, sig, (cert,))
+    return root_kp
 
 
 def audit_keys(rt, validators):

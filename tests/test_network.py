@@ -171,16 +171,19 @@ def storage_net():
     pipe = StoragePipeline(cfg, podr2_key=key)
 
     # genesis-ish setup extrinsics
+    from cess_tpu.chain.attestation import issue_cert, issue_report
     from cess_tpu.crypto.rsa import generate_rsa_keypair
 
     kp = generate_rsa_keypair(1024, seed=5)
+    signer_kp = generate_rsa_keypair(1024, seed=6)
+    mr = b"\x02" * 32
     for n in nodes:
-        n.runtime.apply_extrinsic("root", "tee_worker.update_whitelist", b"mr")
+        n.runtime.apply_extrinsic("root", "tee_worker.update_whitelist", mr)
         n.runtime.apply_extrinsic("root", "tee_worker.pin_ias_signer", kp.public)
-    payload = b"report:mr:" + b"tee-pk"
+    cert = issue_cert(kp, "ias-signer", signer_kp.public)
+    report, rsig = issue_report(signer_kp, mr, b"tee-pk", "tee1")
     node.submit_extrinsic("tee1", "tee_worker.register", "stash1", b"tp",
-                          b"tee-pk", payload, kp.sign_pkcs1v15(payload),
-                          kp.public)
+                          b"tee-pk", report, rsig, (cert,))
     for w in ("m1", "m2", "m3", "m4"):
         node.submit_extrinsic(w, "sminer.regnstk", w, b"p" + w.encode(),
                               2000 * D)
