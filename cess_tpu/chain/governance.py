@@ -39,6 +39,7 @@ COUNCIL_CALLS = {
     "treasury.reject_spend",
     "council.set_members",
     "system.retire_sudo",
+    "system.apply_runtime_upgrade",
 }
 
 
@@ -132,6 +133,13 @@ class Council:
                 self.state.deposit_event(PALLET, "ExecutionFailed",
                                          motion=mid, call=call,
                                          error=e.name)
+            except Exception as e:
+                # arity/type errors from motion args must not leak the
+                # open tx mark (that would desync block undo logs)
+                self.state.rollback_tx()
+                self.state.deposit_event(
+                    PALLET, "ExecutionFailed", motion=mid, call=call,
+                    error=f"council.BadMotionArgs:{type(e).__name__}")
             else:
                 self.state.commit_tx()
                 self.state.deposit_event(PALLET, "Executed", motion=mid,

@@ -71,16 +71,28 @@ def current_versions() -> dict[str, int]:
     return out
 
 
-def stamp_genesis(state: State) -> None:
-    """Fresh chains start at current versions (no migration needed)."""
-    state.put(SYSTEM, "spec_version", SPEC_VERSION)
-    for pallet, version in current_versions().items():
-        state.put(SYSTEM, "storage_version", pallet, version)
+def stamp_genesis(state: State, version: int = SPEC_VERSION) -> None:
+    """Stamp genesis at the CHAIN's genesis spec version (a ChainSpec
+    field, part of the genesis hash) — NOT the running code's version.
+    Any code version therefore reproduces a historical chain's genesis
+    byte-exactly; upgrades activate only via the in-band
+    system.apply_runtime_upgrade extrinsic, so full replay from
+    genesis stays deterministic across code versions."""
+    state.put(SYSTEM, "spec_version", version)
+    versions = current_versions() if version >= SPEC_VERSION \
+        else {pallet: 1 for pallet in current_versions()}
+    for pallet, v in versions.items():
+        state.put(SYSTEM, "storage_version", pallet, v)
 
 
 def run_pending(state: State) -> list[str]:
-    """on_runtime_upgrade analog: run every migration whose pallet
-    storage version is behind; bump versions + spec_version. Returns
+    """on_runtime_upgrade: run every migration whose pallet storage
+    version is behind; bump versions + spec_version. Invoked by the
+    system.apply_runtime_upgrade extrinsic (root/council), so the
+    migration block is part of consensus — every replica and every
+    future replayer on upgraded code executes it at the same height
+    (the reference records upgrades the same way: set_code in a
+    block, migrations at that block's on_runtime_upgrade). Returns
     the applied migration names (events are the caller's job)."""
     applied = []
     for pallet, target, fn in MIGRATIONS:

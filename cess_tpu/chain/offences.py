@@ -117,6 +117,6 @@ class Offences:
             return
         self.state.put(PALLET, "reported", offender, ("era", era), "system")
         slashed = self.staking.slash_fraction(
-            offender, LIVENESS_SLASH_PERMILL)
+            offender, LIVENESS_SLASH_PERMILL, era=era)
         self.state.deposit_event(PALLET, "LivenessFault", offender=offender,
                                  era=era, slashed=slashed)

@@ -50,6 +50,7 @@ ROOT_ONLY = {
     "tee_worker.pin_ias_signer",
     "audit.set_keys",
     "council.set_members",
+    "system.apply_runtime_upgrade",
 }
 
 # the dispatch surface — FRAME's #[pallet::call] analog. Pallet
@@ -63,6 +64,7 @@ SIGNED_CALLS = {
     "storage_handler.renewal_space",
     "sminer.regnstk", "sminer.increase_collateral",
     "sminer.update_beneficiary", "sminer.update_peer_id",
+    "sminer.commit_filler_seed",
     "oss.register", "oss.update", "oss.destroy",
     "oss.authorize", "oss.cancel_authorize",
     "cacher.register", "cacher.update", "cacher.logout", "cacher.pay",
@@ -110,6 +112,7 @@ class RuntimeConfig:
     credit_period_blocks: int | None = None  # default: era_blocks
     audit_challenge_life: int | None = None  # default: audit module constant
     audit_verify_life: int | None = None
+    genesis_spec_version: int = 0   # 0 -> current code version
 
 
 class Runtime:
@@ -166,9 +169,11 @@ class Runtime:
         self.pallets["council"] = self.council
         self.evm = Evm(s, self.balances)
         self.pallets["evm"] = self.evm
-        # fresh chain: stamp current spec/storage versions (snapshots
-        # from older code trigger run_pending at the next init_block)
-        migrations.stamp_genesis(s)
+        # genesis stamps the CHAIN's spec version (ChainSpec field),
+        # reproducible by any code version; upgrades activate via the
+        # system.apply_runtime_upgrade extrinsic
+        migrations.stamp_genesis(s, self.config.genesis_spec_version
+                                 or migrations.SPEC_VERSION)
         self._update_randomness()
 
     # -- dispatch --------------------------------------------------------------
@@ -320,13 +325,6 @@ class Runtime:
         self.state.archive_events()
         self.state.block += 1
         self.state.put("system", "author", author)
-        # on_runtime_upgrade analog: first block authored by upgraded
-        # code runs pending StorageVersion migrations inside block
-        # execution (deterministic, part of the state root)
-        if migrations.spec_version(self.state) < migrations.SPEC_VERSION:
-            for name in migrations.run_pending(self.state):
-                self.state.deposit_event("system", "MigrationApplied",
-                                         migration=name)
         if randomness is not None:
             self.set_randomness(randomness)
         else:

@@ -124,6 +124,22 @@ class Sminer:
         self.state.put(PALLET, "miner", who,
                        dataclasses.replace(m, peer_id=peer_id))
 
+    def commit_filler_seed(self, who: str, commitment: bytes) -> None:
+        """One-time commitment to the miner's PoIS-direction filler
+        seed (node/offchain.py slow_filler_bytes): the TEE certifies
+        secret-seeded fillers only against this on-chain value.
+        Immutable — rotating the seed would orphan certified fillers."""
+        self._require(who)
+        if not isinstance(commitment, bytes) or len(commitment) != 32:
+            raise DispatchError("sminer.BadCommitment")
+        if self.state.contains(PALLET, "filler_seed", who):
+            raise DispatchError("sminer.SeedAlreadyCommitted", who)
+        self.state.put(PALLET, "filler_seed", who, commitment)
+        self.state.deposit_event(PALLET, "FillerSeedCommitted", who=who)
+
+    def filler_seed_commitment_of(self, who: str) -> bytes | None:
+        return self.state.get(PALLET, "filler_seed", who)
+
     # -- MinerControl trait (lib.rs:931-1110) --------------------------------
     def add_miner_idle_space(self, who: str, space: int) -> None:
         """Filler upload certified: miner gains idle space."""

@@ -229,14 +229,16 @@ class Staking:
                                  permill=0)
         return taken
 
-    def slash_fraction(self, who: str, permill: int) -> int:
-        """Slash ``permill``/1000 of the offender's ERA EXPOSURE — own
-        stake and every exposed nominator (Substrate slashes the
-        offending era's exposure, so post-offence unbonding cannot
-        dodge it beyond what already left the bond). Falls back to the
-        live bond when no exposure snapshot exists. Returns the total
-        taken."""
-        e = self.exposure(self.current_era(), who)
+    def slash_fraction(self, who: str, permill: int,
+                       era: int | None = None) -> int:
+        """Slash ``permill``/1000 of the offender's exposure in the
+        OFFENCE era (``era``; defaults to the current one) — own stake
+        and every exposed nominator (Substrate slashes the offending
+        era's exposure, so post-offence unbonding cannot dodge it
+        beyond what already left the bond). Falls back to the live
+        bond when no exposure snapshot exists (pruned or genesis).
+        Returns the total taken."""
+        e = self.exposure(self.current_era() if era is None else era, who)
         if e is None:
             taken = self._slash_one(who, permill)
             for nom, amount in self.nominators_of(who):

@@ -71,6 +71,20 @@ class System:
         self.state.put(PALLET, "sudo", None)
         self.state.deposit_event(PALLET, "SudoRetired")
 
+    # -- runtime upgrade -------------------------------------------------------
+    def apply_runtime_upgrade(self) -> None:
+        """Root/council: activate the running code's pending storage
+        migrations in-band (the set_code + on_runtime_upgrade analog).
+        No-op if already current."""
+        from . import migrations
+
+        for name in migrations.run_pending(self.state):
+            self.state.deposit_event(PALLET, "MigrationApplied",
+                                     migration=name)
+        self.state.deposit_event(
+            PALLET, "RuntimeUpgraded",
+            spec_version=migrations.spec_version(self.state))
+
     # -- misc ------------------------------------------------------------------
     def remark(self, who: str, data: bytes) -> None:
         self.state.deposit_event(PALLET, "Remark", who=who, size=len(data))

@@ -37,6 +37,20 @@ class ChainSpec:
     audit_challenge_life: int | None = None   # None -> audit defaults
     audit_verify_life: int | None = None
     sudo: str | None = None                    # dev root origin account
+    # the spec version the chain was BORN at (part of the genesis
+    # hash): any code version reproduces the genesis byte-exactly;
+    # upgrades activate via system.apply_runtime_upgrade in a block.
+    # 0 = resolved to the current code's version AT CONSTRUCTION, so
+    # the stored field is always concrete and exports/imports
+    # round-trip exactly.
+    genesis_spec_version: int = 0
+
+    def __post_init__(self):
+        if self.genesis_spec_version == 0:
+            from ..chain import migrations
+
+            object.__setattr__(self, "genesis_spec_version",
+                               migrations.SPEC_VERSION)
 
     def session_key(self, account: str) -> ed25519.SigningKey:
         """Deterministic dev session keys derived from the spec id —
@@ -65,13 +79,15 @@ class ChainSpec:
             tuple((v.account, v.bond) for v in self.validators),
             self.era_blocks, self.epoch_blocks, self.fragment_count,
             self.max_validators, self.audit_challenge_life,
-            self.audit_verify_life, self.sudo))).digest()
+            self.audit_verify_life, self.sudo,
+            self.genesis_spec_version))).digest()
 
     def build_runtime(self) -> Runtime:
         rt = Runtime(RuntimeConfig(
             fragment_count=self.fragment_count, era_blocks=self.era_blocks,
             audit_challenge_life=self.audit_challenge_life,
-            audit_verify_life=self.audit_verify_life))
+            audit_verify_life=self.audit_verify_life,
+            genesis_spec_version=self.genesis_spec_version))
         rt.set_genesis_hash(self.genesis_hash())
         if self.sudo:
             rt.system.set_sudo(self.sudo)
@@ -105,6 +121,7 @@ def spec_to_json(spec: ChainSpec) -> dict:
         "audit_challenge_life": spec.audit_challenge_life,
         "audit_verify_life": spec.audit_verify_life,
         "sudo": spec.sudo,
+        "genesis_spec_version": spec.genesis_spec_version,
         "genesis_hash": "0x" + spec.genesis_hash().hex(),
     }
 
@@ -120,7 +137,8 @@ def spec_from_json(data: dict) -> ChainSpec:
         max_validators=data["max_validators"],
         audit_challenge_life=data["audit_challenge_life"],
         audit_verify_life=data["audit_verify_life"],
-        sudo=data.get("sudo"))
+        sudo=data.get("sudo"),
+        genesis_spec_version=data.get("genesis_spec_version", 0))
     want = data.get("genesis_hash")
     if want and "0x" + spec.genesis_hash().hex() != want:
         raise ValueError("chain spec genesis hash mismatch")

@@ -71,14 +71,23 @@ def main(argv=None) -> int:
              for v in spec.validators]
     net = Network(nodes)
     rpc = None
+    import contextlib
+    import threading
+
+    # block production and RPC reads share one lock (RPC iterates
+    # live runtime state; unsynchronized scrapes race block execution)
+    chain_lock = threading.Lock()
     if args.rpc_port:
-        rpc = RpcServer(nodes[0], port=args.rpc_port).start()
+        rpc = RpcServer(nodes[0], port=args.rpc_port,
+                        lock=chain_lock).start()
         print(f"JSON-RPC on 127.0.0.1:{rpc.port}", file=sys.stderr)
     produced = 0
     slot = max(len(nodes[0].chain), 1)
     try:
         while args.blocks == 0 or produced < args.blocks:
-            if net.run_slot(slot) is not None:
+            with chain_lock:
+                made = net.run_slot(slot)
+            if made is not None:
                 produced += 1
                 head = nodes[0].chain[-1]
                 print(f"#{head.number} author={head.author} "

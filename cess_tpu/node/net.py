@@ -325,7 +325,7 @@ class NodeService:
             last_slot = slot
             blk = None
             with self.lock:
-                new_beats = self._queue_heartbeats()
+                new_beats = self.node.queue_heartbeats()
                 try:
                     blk = self.node.try_author(slot)
                     if blk is not None:
@@ -345,26 +345,6 @@ class NodeService:
             for conn in list(self.conns):
                 if conn.alive:
                     self._send_status(conn)
-
-    def _queue_heartbeats(self) -> list:
-        """im-online OCW analog (caller holds the lock). Returns the
-        newly queued heartbeat txs so the author loop can gossip them
-        (authoring a block is NOT guaranteed within an era)."""
-        node = self.node
-        era = node.runtime.staking.current_era()
-        new = []
-        for account in node.keystore:
-            if account not in node.authorities \
-                    or node.runtime.im_online.has_beat(era, account) \
-                    or any(t.call == "im_online.heartbeat"
-                           and t.signer == account for t in node.tx_pool):
-                continue
-            try:
-                node.submit_extrinsic(account, "im_online.heartbeat")
-                new.append(node.tx_pool[-1])
-            except DispatchError:
-                pass
-        return new
 
     # -- client surface ------------------------------------------------------
     def submit(self, xt) -> None:

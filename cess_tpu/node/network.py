@@ -203,6 +203,34 @@ class Node:
         return imported
 
     # -- tx pool ---------------------------------------------------------------
+    def queue_heartbeats(self) -> list[SignedExtrinsic]:
+        """im-online OCW analog shared by both network drivers: queue
+        one heartbeat per era for each local authority key not yet
+        beaten/pending. Returns the newly queued txs (the TCP service
+        gossips them — authoring a block is not guaranteed per era)."""
+        era = self.runtime.staking.current_era()
+        new = []
+        staking = self.runtime.staking
+        for account in self.keystore:
+            # gate matches im_online admission: EXPOSED validators must
+            # beat even when not in the elected author set (the
+            # max_validators cap), else they are liveness-slashed while
+            # fully online
+            is_authority = account in self.authorities \
+                or account in staking.validators() \
+                or account in staking.era_validators(era)
+            if not is_authority \
+                    or self.runtime.im_online.has_beat(era, account) \
+                    or any(t.call == "im_online.heartbeat"
+                           and t.signer == account for t in self.tx_pool):
+                continue
+            try:
+                self.submit_extrinsic(account, "im_online.heartbeat")
+                new.append(self.tx_pool[-1])
+            except DispatchError:
+                pass
+        return new
+
     def submit_extrinsic(self, origin: str, call: str, *args, **kwargs) -> None:
         """Dev-mode convenience: sign with the spec-derived account key
         (the //Alice pattern) and submit. ``origin="root"`` signs as
@@ -519,24 +547,10 @@ class Network:
             node.tx_pool = shared
 
     def _queue_heartbeats(self) -> None:
-        """The im-online OCW analog: each node queues one heartbeat
-        per era for every local authority key (a node that is down
-        queues nothing and is reported at era end)."""
+        """Each node queues heartbeats (a node that is down queues
+        nothing and is reported at era end)."""
         for node in self.nodes:
-            era = node.runtime.staking.current_era()
-            pool = node.tx_pool
-            for account in node.keystore:
-                if account not in node.authorities:
-                    continue
-                if node.runtime.im_online.has_beat(era, account):
-                    continue
-                if any(t.call == "im_online.heartbeat"
-                       and t.signer == account for t in pool):
-                    continue
-                try:
-                    node.submit_extrinsic(account, "im_online.heartbeat")
-                except DispatchError:
-                    pass
+            node.queue_heartbeats()
 
     def run_slot(self, slot: int) -> Block | None:
         """Authors race; fork choice = primary beats secondary, then

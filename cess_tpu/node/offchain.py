@@ -92,13 +92,59 @@ def filler_bytes(miner: str, index: int, size: int) -> bytes:
     stream over (miner, index). Anyone — miner, TEE, auditor — can
     regenerate a filler byte-exactly, which is how the TEE certifies
     filler hashes before the chain credits idle space (the reference's
-    generated idle files, file-bank/src/lib.rs:798-859)."""
+    generated idle files, file-bank/src/lib.rs:798-859).
+
+    Known limitation (documented at file_bank.upload_filler): publicly
+    derivable content proves TAG possession, not disk. The
+    PoIS-direction upgrade is :func:`slow_filler_bytes`."""
     out = bytearray()
     seed = b"cess-filler:" + miner.encode() + index.to_bytes(8, "little")
     ctr = 0
     while len(out) < size:
         out += hashlib.sha256(seed + ctr.to_bytes(8, "little")).digest()
         ctr += 1
+    return bytes(out[:size])
+
+
+SLOW_FILLER_WORK = 2048   # sequential hashes per 512-B block (cost knob)
+
+
+def filler_seed_commitment(secret: bytes) -> bytes:
+    """The on-chain commitment to a miner's filler seed."""
+    return hashlib.sha256(b"cess-filler-seed:" + secret).digest()
+
+
+def slow_filler_bytes(secret: bytes, index: int, size: int,
+                      work: int = SLOW_FILLER_WORK) -> bytes:
+    """PoIS-direction filler content (the upgrade CESS itself made —
+    SURVEY.md notes idle files were later replaced by PoIS):
+
+    - seeded by a MINER SECRET (committed on chain via
+      sminer.commit_filler_seed), so the network at large cannot
+      derive the content; the TEE learns the secret once, inside the
+      enclave, at certification time;
+    - each 512-byte block is the output of a ``work``-step SEQUENTIAL
+      hash chain, so even the secret-holding miner cannot cheaply
+      regenerate challenged blocks inside an audit window: answering
+      a ~47-block challenge without the data costs ~47*work sequential
+      hashes per filler, versus one disk read each — dedicated storage
+      becomes the rational strategy, which is what the idle-space
+      ledger is supposed to measure.
+
+    Audit verification is UNAFFECTED: the TEE tags the content once at
+    certification; challenges verify against tags (Shacham-Waters),
+    never by regeneration.
+    """
+    block_bytes = 512
+    out = bytearray()
+    for j in range(-(-size // block_bytes)):
+        state = hashlib.sha256(
+            b"cess-pois-filler:" + secret + index.to_bytes(8, "little")
+            + j.to_bytes(8, "little")).digest()
+        for _ in range(work):          # the sequential cost
+            state = hashlib.sha256(state).digest()
+        for c in range(block_bytes // 32):   # cheap expansion
+            out += hashlib.sha256(state + c.to_bytes(4, "little")).digest()
     return bytes(out[:size])
 
 
@@ -124,6 +170,27 @@ class MinerAgent:
         blobs = [filler_bytes(self.account, i, size) for i in range(count)]
         hashes, tags, sig = tee.certify_fillers(self.account,
                                                 list(range(count)), blobs)
+        for h, blob, tag in zip(hashes, blobs, tags):
+            self.filler_store[h] = blob
+            self.filler_tags[h] = tag
+        self.node.submit_extrinsic(self.account, "file_bank.upload_filler",
+                                   tuple(hashes), tee.controller, sig)
+
+    def commit_filler_seed(self, secret: bytes) -> None:
+        """Submit the one-time on-chain seed commitment; it must be in
+        a block before the TEE will certify (run a slot in between)."""
+        self.node.submit_extrinsic(self.account,
+                                   "sminer.commit_filler_seed",
+                                   filler_seed_commitment(secret))
+
+    def setup_fillers_pois(self, tee: "TeeAgent", count: int,
+                           secret: bytes,
+                           work: int = SLOW_FILLER_WORK) -> None:
+        """Secret-seeded filler setup: the seed commitment must
+        already be on chain (commit_filler_seed); the TEE derives +
+        certifies against it, then the batch is registered."""
+        hashes, tags, sig, blobs = tee.certify_pois_fillers(
+            self.account, secret, list(range(count)), work)
         for h, blob, tag in zip(hashes, blobs, tags):
             self.filler_store[h] = blob
             self.filler_tags[h] = tag
@@ -287,8 +354,6 @@ class TeeAgent:
         (miner, index), tag it, and sign the hash batch bound to the
         miner's on-chain cert nonce — the attestation
         file_bank.upload_filler verifies (and consumes) on chain."""
-        from ..chain.file_bank import FileBank
-
         expected_size = self.blocks * podr2.BLOCK_BYTES
         if len(indices) != len(blobs) or len(set(indices)) != len(indices):
             raise ValueError("indices/blobs mismatch")
@@ -296,6 +361,34 @@ class TeeAgent:
             if len(blob) != expected_size \
                     or blob != filler_bytes(miner, i, expected_size):
                 raise ValueError(f"filler {i} content not canonical")
+        return self._tag_and_sign(miner, blobs)
+
+    def certify_pois_fillers(self, miner: str, secret: bytes,
+                             indices: list[int],
+                             work: int = SLOW_FILLER_WORK):
+        """PoIS-direction variant (see slow_filler_bytes): the miner
+        hands its filler seed to the ENCLAVE; the TEE checks it against
+        the miner's on-chain commitment, derives the secret-seeded
+        sequential content itself, tags and signs the batch through
+        the SAME cert flow. Returns (hashes, tags, sig, blobs) — the
+        derived blobs, so callers need not re-plot."""
+        commitment = self.node.runtime.sminer.filler_seed_commitment_of(
+            miner)
+        if commitment is None \
+                or filler_seed_commitment(secret) != commitment:
+            raise ValueError("filler seed does not match the miner's "
+                             "on-chain commitment")
+        if len(set(indices)) != len(indices):
+            raise ValueError("duplicate filler indices")
+        expected_size = self.blocks * podr2.BLOCK_BYTES
+        blobs = [slow_filler_bytes(secret, i, expected_size, work)
+                 for i in indices]
+        hashes, tags, sig = self._tag_and_sign(miner, blobs)
+        return hashes, tags, sig, blobs
+
+    def _tag_and_sign(self, miner: str, blobs: list[bytes]):
+        from ..chain.file_bank import FileBank
+
         hashes = [fragment_hash(b) for b in blobs]
         ids = np.stack([podr2.fragment_id_from_hash(h) for h in hashes])
         tags = np.asarray(podr2.tag_fragments(

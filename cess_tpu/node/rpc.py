@@ -49,8 +49,13 @@ def _decode(obj):
 
 
 class RpcServer:
-    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9944):
+    def __init__(self, node: Node, host: str = "127.0.0.1",
+                 port: int = 9944, lock=None):
         self.node = node
+        # the block-producing side must hold the SAME lock while
+        # mutating node/runtime state (cli loop, NodeService): RPC
+        # reads iterate live dicts and would otherwise race
+        self.lock = lock if lock is not None else threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -64,7 +69,8 @@ class RpcServer:
                     return
                 from .metrics import render_metrics
 
-                data = render_metrics(server.node).encode()
+                with server.lock:
+                    data = render_metrics(server.node).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -76,8 +82,9 @@ class RpcServer:
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(length))
-                    result = server.handle(req.get("method", ""),
-                                           req.get("params", []))
+                    with server.lock:
+                        result = server.handle(req.get("method", ""),
+                                               req.get("params", []))
                     body = {"jsonrpc": "2.0", "id": req.get("id"),
                             "result": _encode(result)}
                 except Exception as e:  # JSON-RPC error envelope

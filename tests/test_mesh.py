@@ -59,3 +59,71 @@ def test_sharded_proof_matches_single_device(setup):
     ok = podr2.verify_batch(pipe.podr2_key, jnp.asarray(ids).reshape(-1),
                             blocks, idx, nu, mu, sigma)
     assert np.asarray(ok).all()
+
+
+def test_multihost_corpus_run_single_process():
+    """The multi-host corpus path (global mesh + host-local ingest via
+    make_array_from_process_local_data + streamed batches) on the
+    8-device CPU mesh: single-process takes the SAME code path as a
+    real multi-host run except distributed.initialize."""
+    import numpy as np
+
+    from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+    from cess_tpu.parallel import multihost
+
+    assert multihost.init_multihost() == 1   # nothing configured: no-op
+    mesh = multihost.global_mesh(seg=4, byte=2)
+    cfg = PipelineConfig(k=2, m=1, segment_size=8192)
+    pipe = StoragePipeline(cfg)
+    plan = multihost.CorpusPlan(total_bytes=8 * 8192, segment_size=8192,
+                                batch_segments=4)
+    assert plan.total_segments == 8 and plan.num_batches == 2
+
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, 256, (8, 2, 4096), dtype=np.uint8)
+
+    def local_batch(b, local_segs):
+        return corpus[b * local_segs:(b + 1) * local_segs]
+
+    results = list(multihost.run_corpus(pipe, mesh, plan, local_batch))
+    assert len(results) == 2
+    for r in results:
+        assert r["verified"] == r["expected"], r
+
+
+def test_multihost_corpus_partial_final_batch():
+    """A corpus that is not a multiple of the batch size: the final
+    partial batch is padded to the compiled shape and padded segments
+    are masked out of the verified count."""
+    import numpy as np
+
+    from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+    from cess_tpu.parallel import multihost
+
+    mesh = multihost.global_mesh(seg=4, byte=2)
+    cfg = PipelineConfig(k=2, m=1, segment_size=8192)
+    pipe = StoragePipeline(cfg)
+    # 9 segments, batches of 4 -> 4 + 4 + 1
+    plan = multihost.CorpusPlan(total_bytes=9 * 8192, segment_size=8192,
+                                batch_segments=4)
+    assert plan.num_batches == 3
+    rng = np.random.default_rng(2)
+    corpus = rng.integers(0, 256, (9, 2, 4096), dtype=np.uint8)
+    offset = [0]
+
+    def local_batch(b, local_want):
+        got = corpus[offset[0]:offset[0] + local_want]
+        offset[0] += local_want
+        return got
+
+    results = list(multihost.run_corpus(pipe, mesh, plan, local_batch))
+    assert [r["segments"] for r in results] == [4, 4, 1]
+    for r in results:
+        assert r["verified"] == r["expected"] == r["segments"] * 3, r
+    # indivisible batch config is an explicit error, not silent drop
+    import pytest
+
+    bad = multihost.CorpusPlan(total_bytes=8 * 8192, segment_size=8192,
+                               batch_segments=6)
+    with pytest.raises(ValueError, match="divide"):
+        next(iter(multihost.run_corpus(pipe, mesh, bad, local_batch)))

@@ -319,3 +319,64 @@ def test_dropped_filler_fails_idle_audit_and_punishes(storage_net):
     assert all(n.runtime.state.state_root()
                == net.nodes[0].runtime.state.state_root()
                for n in net.nodes)
+
+
+def test_pois_filler_setup_and_audit(storage_net):
+    """PoIS-direction fillers (round-2 VERDICT #10): secret-seeded,
+    sequentially-slow filler content behind the SAME cert flow —
+    committed seed checked by the TEE, content not publicly derivable,
+    and the registered fillers pass the idle audit."""
+    from cess_tpu.chain.state import DispatchError
+    from cess_tpu.node.offchain import (MinerAgent, filler_bytes,
+                                        filler_seed_commitment,
+                                        slow_filler_bytes)
+
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    secret = b"m5-plot-secret"
+    node.submit_extrinsic("alice", "balances.transfer", "m5", 10_000 * D)
+    net.run_slots(1)
+    node.submit_extrinsic("m5", "sminer.regnstk", "m5", b"pm5", 2000 * D)
+    net.run_slots(1)
+    m5 = MinerAgent(node, "m5", [gw], miners[0].pipeline)
+    # TEE refuses before the commitment is on chain
+    with pytest.raises(ValueError, match="commitment"):
+        tee.certify_pois_fillers("m5", secret, [0], work=4)
+    m5.commit_filler_seed(secret)
+    net.run_slots(1)
+    # TEE refuses a WRONG secret against the commitment
+    with pytest.raises(ValueError, match="commitment"):
+        tee.certify_pois_fillers("m5", b"not-the-secret", [0], work=4)
+    idle0 = node.runtime.sminer.get_miner_idle_space("m5")
+    m5.setup_fillers_pois(tee, 3, secret, work=4)
+    net.run_slots(1)
+    assert node.runtime.sminer.get_miner_idle_space("m5") \
+        == idle0 + 3 * constants.FRAGMENT_SIZE
+    # content is secret-dependent and NOT the public PRF stream
+    size = cfg.fragment_size
+    assert slow_filler_bytes(secret, 0, size, work=4) \
+        != slow_filler_bytes(b"other", 0, size, work=4)
+    assert slow_filler_bytes(secret, 0, size, work=4) \
+        != filler_bytes("m5", 0, size)
+    # the commitment is one-time
+    with pytest.raises(DispatchError, match="SeedAlreadyCommitted"):
+        node.runtime.apply_extrinsic(
+            "m5", "sminer.commit_filler_seed",
+            filler_seed_commitment(b"rotated"))
+    # the registered pois fillers answer the next idle audit
+    node.offchain_agents.append(m5)
+    node.submit_extrinsic("root", "audit.set_keys", ("v0", "v1", "v2"))
+    for v in ("v0", "v1", "v2"):
+        node.submit_extrinsic(v, "system.set_session_key",
+                              spec.session_key(v).public)
+    net.run_slots(2)
+    rt = node.runtime
+    start = rt.state.block
+    for _ in range(40):
+        net.run_slots(1)
+        ev = rt.state.events_of("audit", "VerifyResult")
+        if any(dict(e.data)["miner"] == "m5" for e in ev):
+            break
+    results = [dict(e.data) for e in
+               rt.state.events_of("audit", "VerifyResult")
+               if dict(e.data)["miner"] == "m5"]
+    assert results and results[-1]["idle"] is True, results

@@ -23,22 +23,26 @@ def test_fresh_chain_is_current_version():
     assert not rt.state.events_of("system", "MigrationApplied")
 
 
-def test_old_version_state_migrates_in_first_block():
+def test_upgrade_extrinsic_migrates_old_state():
     """Simulate a round-2-format state: spec_version behind, a
     validator without prefs, fingerprint-format attestation pins.
-    The first block of upgraded code must migrate + bump, in-band."""
-    rt = Runtime(RuntimeConfig(era_blocks=1000))
+    The in-band system.apply_runtime_upgrade extrinsic (root/council)
+    runs the gated migrations and bumps versions — and because it is
+    an EXTRINSIC in a block, full replay on any future code stays
+    deterministic (no code-conditional state changes)."""
+    rt = Runtime(RuntimeConfig(era_blocks=1000, genesis_spec_version=109))
     s = rt.state
-    # rewind the version stamps to the old runtime
-    s.put("system", "spec_version", 109)
-    s.put("system", "storage_version", "staking", 1)
-    s.put("system", "storage_version", "tee_worker", 1)
+    assert migrations.spec_version(s) == 109
+    assert migrations.storage_version(s, "staking") == 1
     # old-format artifacts
     rt.fund("v9", 2_000_000 * D)
     rt.apply_extrinsic("v9", "staking.bond", 1_500_000 * D)
     s.put("staking", "validators", ("v9",))     # no prefs entry
     s.put("tee_worker", "ias_pins", (b"\xab" * 32,))  # fingerprint pin
     rt.advance_blocks(1)
+    # nothing migrates until the upgrade is ACTIVATED in-band
+    assert migrations.spec_version(s) == 109
+    rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
     ev = rt.state.events_of("system", "MigrationApplied")
     assert {dict(e.data)["migration"] for e in ev} \
         == {"staking-v2(1)", "tee_worker-v2(1)"}
@@ -46,39 +50,44 @@ def test_old_version_state_migrates_in_first_block():
     assert migrations.storage_version(s, "staking") == 2
     assert s.get("staking", "prefs", "v9") == 0
     assert s.get("tee_worker", "ias_pins") == ()
-    # second block: nothing left to migrate
-    rt.advance_blocks(1)
+    # idempotent: a second activation migrates nothing new
+    rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
     assert len(rt.state.events_of("system", "MigrationApplied")) == len(ev)
 
 
-def test_old_snapshot_restores_then_migrates(tmp_path, monkeypatch):
-    """A node restarted on upgraded code over an old-version snapshot
-    migrates at its first authored block. The 'old software' run is
-    simulated by pinning SPEC_VERSION=109 with no migrations, so its
-    persisted state (and block state roots) genuinely carry the old
-    stamps."""
+def test_old_chain_restarts_and_upgrades_in_band(tmp_path):
+    """A chain born at spec 109 restarts on current code (genesis
+    reproduced byte-exactly from the spec's pinned version), then
+    upgrades via the root extrinsic; a FRESH node replaying the full
+    block log — including the upgrade block — converges to the same
+    state (the property code-conditional migrations would break)."""
+    import dataclasses as dc
+
     from cess_tpu.node.chain_spec import dev_spec
     from cess_tpu.node.network import Network, Node
 
-    spec = dev_spec()
+    spec = dc.replace(dev_spec(), genesis_spec_version=109)
     base = str(tmp_path / "n0")
-    monkeypatch.setattr(migrations, "SPEC_VERSION", 109)
-    monkeypatch.setattr(migrations, "MIGRATIONS", [])
     node = Node(spec, "n0", {"alice": spec.session_key("alice")},
-                base_path=base, snapshot_interval=2)
-    Network([node]).run_slots(4)
+                base_path=base, snapshot_interval=1000)
+    Network([node]).run_slots(3)
     assert migrations.spec_version(node.runtime.state) == 109
     del node
-    monkeypatch.undo()   # "deploy" the upgraded runtime
     restarted = Node(spec, "n0b", {"alice": spec.session_key("alice")},
-                     base_path=base, snapshot_interval=2)
+                     base_path=base, snapshot_interval=1000)
     assert migrations.spec_version(restarted.runtime.state) == 109
-    Network([restarted]).run_slots(1)
+    restarted.submit_extrinsic("root", "system.apply_runtime_upgrade")
+    Network([restarted]).run_slots(2)
     assert migrations.spec_version(restarted.runtime.state) \
         == migrations.SPEC_VERSION
-    ev = restarted.runtime.state.events_of("system", "MigrationApplied")
-    assert {dict(e.data)["migration"] for e in ev} \
-        == {"staking-v2(0)", "tee_worker-v2(0)"}
+    # full replay from genesis on current code reproduces the chain
+    # THROUGH the upgrade block
+    fresh = Node(spec, "fresh", {})
+    assert fresh.sync_from(restarted) == restarted.head().number
+    assert fresh.runtime.state.state_root() \
+        == restarted.runtime.state.state_root()
+    assert migrations.spec_version(fresh.runtime.state) \
+        == migrations.SPEC_VERSION
 
 
 # -- EVM boundary -------------------------------------------------------------
