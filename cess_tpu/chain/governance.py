@@ -37,6 +37,9 @@ MOTION_LIFE_BLOCKS = 7 * constants.ONE_DAY_BLOCKS   # ref MotionDuration
 COUNCIL_CALLS = {
     "treasury.approve_spend",
     "treasury.reject_spend",
+    "treasury.approve_bounty",
+    "treasury.award_bounty",
+    "treasury.close_bounty",
     "council.set_members",
     "system.retire_sudo",
     "system.apply_runtime_upgrade",
@@ -225,3 +228,70 @@ class Treasury:
             else:
                 left.append((beneficiary, amount))
         self.state.put(TREASURY_PALLET, "approved", tuple(left))
+
+    # -- bounties (the reference composes pallet_bounties,
+    # runtime/src/lib.rs:1521) ------------------------------------------------
+    def propose_bounty(self, who: str, description: bytes,
+                       value: int) -> int:
+        """Anyone proposes a bounty (bonding like a spend proposal);
+        it becomes fundable only via council approval."""
+        if not isinstance(value, int) or value <= 0 \
+                or not isinstance(description, bytes) \
+                or len(description) > 128:
+            raise DispatchError("treasury.InvalidBounty")
+        bond = max(value * PROPOSAL_BOND_PERMILL // 1000,
+                   PROPOSAL_BOND_MIN)
+        self.balances.reserve(who, bond)
+        bid = self.state.get(TREASURY_PALLET, "next_bounty", default=0)
+        self.state.put(TREASURY_PALLET, "next_bounty", bid + 1)
+        self.state.put(TREASURY_PALLET, "bounty", bid,
+                       (who, description, value, bond, "proposed"))
+        self.state.deposit_event(TREASURY_PALLET, "BountyProposed",
+                                 bounty=bid, value=value)
+        return bid
+
+    def bounty(self, bid: int):
+        return self.state.get(TREASURY_PALLET, "bounty", bid)
+
+    # COUNCIL-ONLY (reachable only through motions)
+    def approve_bounty(self, bid: int) -> None:
+        b = self.bounty(bid)
+        if b is None or b[4] != "proposed":
+            raise DispatchError("treasury.NoBounty", str(bid))
+        who, desc, value, bond, _ = b
+        self.balances.unreserve(who, bond)
+        self.state.put(TREASURY_PALLET, "bounty", bid,
+                       (who, desc, value, 0, "active"))
+        self.state.deposit_event(TREASURY_PALLET, "BountyApproved",
+                                 bounty=bid)
+
+    def award_bounty(self, bid: int, beneficiary: str) -> None:
+        """Council awards an active bounty: the value joins the
+        spend-period queue for the beneficiary."""
+        if not isinstance(beneficiary, str) or not beneficiary:
+            raise DispatchError("treasury.InvalidBounty", "beneficiary")
+        b = self.bounty(bid)
+        if b is None or b[4] != "active":
+            raise DispatchError("treasury.NoBounty", str(bid))
+        _, _, value, _, _ = b
+        self.state.delete(TREASURY_PALLET, "bounty", bid)
+        approved = self.state.get(TREASURY_PALLET, "approved", default=())
+        self.state.put(TREASURY_PALLET, "approved",
+                       approved + ((beneficiary, value),))
+        self.state.deposit_event(TREASURY_PALLET, "BountyAwarded",
+                                 bounty=bid, beneficiary=beneficiary,
+                                 amount=value)
+
+    def close_bounty(self, bid: int) -> None:
+        """Council drops a bounty; a still-'proposed' bounty's bond is
+        slashed to the treasury (spurious proposal), an active one is
+        simply retired."""
+        b = self.bounty(bid)
+        if b is None:
+            raise DispatchError("treasury.NoBounty", str(bid))
+        who, _, _, bond, status = b
+        self.state.delete(TREASURY_PALLET, "bounty", bid)
+        if status == "proposed" and bond:
+            self.balances.slash_reserved(who, bond, TREASURY_ACCOUNT)
+        self.state.deposit_event(TREASURY_PALLET, "BountyClosed",
+                                 bounty=bid)

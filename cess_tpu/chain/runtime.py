@@ -72,7 +72,7 @@ SIGNED_CALLS = {
     "staking.nominate",
     "im_online.heartbeat",
     "council.propose", "council.vote", "council.close",
-    "treasury.propose_spend",
+    "treasury.propose_spend", "treasury.propose_bounty",
     "evm.deposit", "evm.withdraw", "evm.deploy", "evm.call",
     "tee_worker.register", "tee_worker.exit",
     "file_bank.create_bucket", "file_bank.delete_bucket",

@@ -1,9 +1,16 @@
-"""Node CLI (reference: node/src/cli.rs + command.rs).
+"""Node CLI (reference: node/src/cli.rs + command.rs: run, key tools
+(key/sign/verify), build-spec, check/export/import/revert blocks).
 
   python -m cess_tpu.node.cli --dev --blocks 20 --rpc-port 9944
   python -m cess_tpu.node.cli --chain local --validators 4 --blocks 50
   python -m cess_tpu.node.cli build-spec --chain dev
   python -m cess_tpu.node.cli key --suri my-seed
+  python -m cess_tpu.node.cli sign --suri my-seed --message 0xdead
+  python -m cess_tpu.node.cli verify --public 0x.. --message 0x.. --signature 0x..
+  python -m cess_tpu.node.cli export-blocks --dev --base-path data --to chain.blocks
+  python -m cess_tpu.node.cli import-blocks --dev --base-path data2 --from chain.blocks
+  python -m cess_tpu.node.cli revert --dev --base-path data --blocks 3
+  python -m cess_tpu.node.cli check-block --dev --base-path data --number 5
 """
 from __future__ import annotations
 
@@ -32,7 +39,9 @@ def _load_spec(chain: str, validators: int):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="cess-tpu-node")
     ap.add_argument("subcommand", nargs="?", default="run",
-                    choices=["run", "build-spec", "key"])
+                    choices=["run", "build-spec", "key", "sign",
+                             "verify", "export-blocks", "import-blocks",
+                             "revert", "check-block"])
     ap.add_argument("--dev", action="store_true",
                     help="single-authority dev chain")
     ap.add_argument("--chain", default="dev",
@@ -47,13 +56,37 @@ def main(argv=None) -> int:
     ap.add_argument("--base-path", default=None,
                     help="persist chain data here and resume on restart")
     ap.add_argument("--suri", default="dev-seed", help="key seed material")
+    ap.add_argument("--message", default="0x", help="hex payload (sign/verify)")
+    ap.add_argument("--public", default="", help="hex public key (verify)")
+    ap.add_argument("--signature", default="", help="hex signature (verify)")
+    ap.add_argument("--to", default="chain.blocks", help="export target file")
+    ap.add_argument("--from", dest="from_file", default="chain.blocks",
+                    help="import source file")
+    ap.add_argument("--number", type=int, default=None,
+                    help="block (check-block; default: head)")
     args = ap.parse_args(argv)
+
+    def unhex(s: str) -> bytes:
+        return bytes.fromhex(s[2:] if s.startswith("0x") else s)
 
     if args.subcommand == "key":
         key = ed25519.SigningKey.generate(args.suri.encode())
         print(json.dumps({"public": "0x" + key.public.hex(),
                           "seed": "0x" + key.seed.hex()}))
         return 0
+
+    if args.subcommand == "sign":
+        key = ed25519.SigningKey.generate(args.suri.encode())
+        sig = key.sign(unhex(args.message))
+        print(json.dumps({"public": "0x" + key.public.hex(),
+                          "signature": "0x" + sig.hex()}))
+        return 0
+
+    if args.subcommand == "verify":
+        ok = ed25519.verify(unhex(args.public), unhex(args.message),
+                            unhex(args.signature))
+        print(json.dumps({"valid": bool(ok)}))
+        return 0 if ok else 1
 
     spec = dev_spec() if args.dev else _load_spec(args.chain,
                                                   args.validators)
@@ -63,6 +96,13 @@ def main(argv=None) -> int:
 
     import os
 
+    if args.subcommand in ("export-blocks", "import-blocks", "revert",
+                           "check-block"):
+        if not args.base_path:
+            print("--base-path required", file=sys.stderr)
+            return 1
+        return _block_tool(args, spec)
+
     nodes = [Node(spec, f"node-{v.account}",
                   {v.account: spec.session_key(v.account)},
                   base_path=(os.path.join(args.base_path,
@@ -71,7 +111,6 @@ def main(argv=None) -> int:
              for v in spec.validators]
     net = Network(nodes)
     rpc = None
-    import contextlib
     import threading
 
     # block production and RPC reads share one lock (RPC iterates
@@ -102,6 +141,88 @@ def main(argv=None) -> int:
         if rpc:
             rpc.stop()
     return 0
+
+
+def _block_tool(args, spec) -> int:
+    """check/export/import/revert blocks (command.rs analogs). Each
+    loads the node from --base-path (which replays + verifies the
+    whole log through normal import) and operates on the canonical
+    chain."""
+    import os
+
+    from . import store as _store
+
+    base = os.path.join(args.base_path, f"node-{spec.validators[0].account}")
+    if not os.path.isdir(base):
+        # fall back to a direct node dir only if it actually IS one;
+        # otherwise create the canonical layout so a later `run
+        # --base-path` finds what we write here
+        if os.path.exists(os.path.join(args.base_path,
+                                       _store.BLOCKS_FILE)):
+            base = args.base_path
+        else:
+            os.makedirs(base, exist_ok=True)
+    node = Node(spec, "tool", {}, base_path=base)
+    head = node.head().number
+
+    if args.subcommand == "check-block":
+        n = head if args.number is None else args.number
+        if not 0 <= n <= head:
+            print(f"block {n} out of range (head #{head})",
+                  file=sys.stderr)
+            return 1
+        h = node.chain[n]
+        # the load above already re-executed and root-checked the chain
+        print(json.dumps({"number": n, "hash": "0x" + h.hash().hex(),
+                          "state_root": "0x" + h.state_root.hex(),
+                          "author": h.author, "verified": True}))
+        return 0
+
+    if args.subcommand == "export-blocks":
+        if os.path.exists(args.to):
+            os.remove(args.to)   # truncate: re-exports must not append
+        exp = _store.BlockStore(args.to)
+        for n in range(1, head + 1):
+            exp.append(node.block_bodies[n])
+        exp.close()
+        print(f"exported #{1}..#{head} to {args.to}", file=sys.stderr)
+        return 0
+
+    if args.subcommand == "import-blocks":
+        src_store = _store.BlockStore(args.from_file)
+        imported = 0
+        for block in src_store:
+            try:
+                node.import_block(block)
+                imported += 1
+            except ValueError:
+                continue   # duplicates / stale forks
+        print(f"imported {imported} blocks, head #{node.head().number}",
+              file=sys.stderr)
+        return 0
+
+    if args.subcommand == "revert":
+        target = max(0, head - args.blocks)
+        if target < node.finalized:
+            print(f"refusing to revert below finalized "
+                  f"#{node.finalized}", file=sys.stderr)
+            return 1
+        # rewrite the block log up to the target and drop the snapshot
+        # (the next start replays the truncated log)
+        blocks_file = os.path.join(base, _store.BLOCKS_FILE)
+        tmp = blocks_file + ".tmp"
+        out = _store.BlockStore(tmp)
+        for n in range(1, target + 1):
+            out.append(node.block_bodies[n])
+        out.close()
+        node.store.close()
+        os.replace(tmp, blocks_file)
+        snap = os.path.join(base, _store.SNAPSHOT_FILE)
+        if os.path.exists(snap):
+            os.remove(snap)
+        print(f"reverted to #{target}", file=sys.stderr)
+        return 0
+    return 1
 
 
 if __name__ == "__main__":

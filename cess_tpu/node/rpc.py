@@ -24,6 +24,24 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .network import Node
 
+MAX_BODY = 1 * 1024 * 1024   # request size cap (jsonrpsee-style limit)
+
+
+class RpcError(Exception):
+    """Typed JSON-RPC 2.0 error (code + message)."""
+
+    def __init__(self, code: int, message: str):
+        self.code = code
+        self.message = message
+        super().__init__(message)
+
+
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INVALID_REQUEST = -32600
+PARSE_ERROR = -32700
+SERVER_ERROR = -32000   # dispatch/application errors
+
 
 def _encode(obj):
     if isinstance(obj, bytes):
@@ -79,17 +97,47 @@ class RpcServer:
                 self.wfile.write(data)
 
             def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
+                req_id = None
                 try:
-                    req = json.loads(self.rfile.read(length))
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                    except (TypeError, ValueError):
+                        raise RpcError(INVALID_REQUEST,
+                                       "bad Content-Length") from None
+                    if length > MAX_BODY:
+                        # drain (bounded) so the client can read the
+                        # error envelope instead of a broken pipe
+                        left = length
+                        while left > 0:
+                            chunk = self.rfile.read(min(left, 65536))
+                            if not chunk:
+                                break
+                            left -= len(chunk)
+                        raise RpcError(INVALID_REQUEST,
+                                       f"request exceeds {MAX_BODY} bytes")
+                    try:
+                        req = json.loads(self.rfile.read(length))
+                    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                        raise RpcError(PARSE_ERROR, str(e)) from None
+                    if not isinstance(req, dict):
+                        raise RpcError(INVALID_REQUEST, "not an object")
+                    req_id = req.get("id")
+                    params = req.get("params", [])
+                    if not isinstance(params, list):
+                        raise RpcError(INVALID_PARAMS, "params: not a list")
                     with server.lock:
                         result = server.handle(req.get("method", ""),
-                                               req.get("params", []))
-                    body = {"jsonrpc": "2.0", "id": req.get("id"),
+                                               params)
+                    body = {"jsonrpc": "2.0", "id": req_id,
                             "result": _encode(result)}
-                except Exception as e:  # JSON-RPC error envelope
-                    body = {"jsonrpc": "2.0", "id": None,
-                            "error": {"code": -32000, "message": str(e)}}
+                except RpcError as e:
+                    body = {"jsonrpc": "2.0", "id": req_id,
+                            "error": {"code": e.code,
+                                      "message": e.message}}
+                except Exception as e:  # application-level failure
+                    body = {"jsonrpc": "2.0", "id": req_id,
+                            "error": {"code": SERVER_ERROR,
+                                      "message": str(e)}}
                 data = json.dumps(body).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -127,6 +175,9 @@ class RpcServer:
             return node.finalized
         if method == "chain_getHeader":
             n = params[0] if params else len(node.chain) - 1
+            if not isinstance(n, int) or not 0 <= n < len(node.chain):
+                raise RpcError(INVALID_PARAMS,
+                               f"block number out of range: {n!r}")
             return node.chain[n]
         if method == "state_getStorage":
             key = tuple(_decode(p) for p in params)
@@ -149,6 +200,8 @@ class RpcServer:
             node.submit_signed(xt)
             return True
         if method == "system_accountNextIndex":
+            if not params or not isinstance(params[0], str):
+                raise RpcError(INVALID_PARAMS, "expected [account]")
             return node.runtime.system.nonce(params[0])
         if method == "cess_minerInfo":
             return rt.sminer.miner(params[0])
@@ -167,4 +220,4 @@ class RpcServer:
             from .metrics import collect
 
             return collect(node)
-        raise ValueError(f"unknown method {method!r}")
+        raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")

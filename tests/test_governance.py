@@ -131,3 +131,36 @@ def test_member_change_purges_stale_votes(rt):
     with pytest.raises(DispatchError, match="TooEarly"):
         rt.apply_extrinsic("c1", "council.close", mid)
     assert rt.treasury_pallet.proposal(pid) is not None
+
+
+def test_bounty_lifecycle(rt):
+    from cess_tpu.chain.governance import PROPOSAL_BOND_MIN
+
+    t0 = rt.balances.free("treasury")
+    bid = rt.apply_extrinsic("prop", "treasury.propose_bounty",
+                             b"build the thing", 50_000 * D)
+    bond = 50_000 * D * PROPOSAL_BOND_PERMILL // 1000
+    assert rt.balances.reserved("prop") == bond
+    # approval only via council
+    with pytest.raises(DispatchError, match="UnknownCall"):
+        rt.apply_extrinsic("prop", "treasury.approve_bounty", bid)
+
+    def motion(call, args):
+        rt.apply_extrinsic("c1", "council.propose", call, args)
+        mid = rt.state.get("council", "next_motion") - 1
+        rt.apply_extrinsic("c2", "council.vote", mid, True)
+        rt.apply_extrinsic("c1", "council.close", mid)
+
+    motion("treasury.approve_bounty", (bid,))
+    assert rt.treasury_pallet.bounty(bid)[4] == "active"
+    assert rt.balances.reserved("prop") == 0
+    motion("treasury.award_bounty", (bid, "hunter"))
+    rt.advance_blocks(ERA)    # spend period pays
+    assert rt.balances.free("hunter") == 50_000 * D
+    # closing a spurious proposed bounty slashes its bond
+    bid2 = rt.apply_extrinsic("prop", "treasury.propose_bounty",
+                              b"spam", 10_000 * D)
+    motion("treasury.close_bounty", (bid2,))
+    assert rt.treasury_pallet.bounty(bid2) is None
+    bond2 = 10_000 * D * PROPOSAL_BOND_PERMILL // 1000
+    assert rt.balances.free("treasury") == t0 - 50_000 * D + bond2

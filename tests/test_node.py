@@ -176,3 +176,86 @@ def test_local_spec_multinode_eras_rotate():
     assert all(n.runtime.staking.current_era() >= 1 for n in nodes)
     assert all(n.runtime.state.state_root()
                == nodes[0].runtime.state.state_root() for n in nodes)
+
+
+def test_rpc_error_codes():
+    """JSON-RPC 2.0 error discipline (round-2 weak #10): typed codes,
+    id propagation, param validation, body limit."""
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "n0", {"alice": spec.session_key("alice")})
+    Network([node]).run_slots(2)
+    rpc = RpcServer(node, port=0).start()
+    try:
+        def raw(data: bytes):
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{rpc.port}", data=data,
+                    headers={"Content-Type": "application/json"})) as r:
+                return json.loads(r.read())
+
+        def call(method, *params, id=7):
+            return raw(json.dumps({"jsonrpc": "2.0", "id": id,
+                                   "method": method,
+                                   "params": list(params)}).encode())
+
+        assert call("no_such")["error"]["code"] == -32601
+        assert call("no_such")["id"] == 7          # id propagated
+        assert raw(b"{not json")["error"]["code"] == -32700
+        assert raw(b'"a string"')["error"]["code"] == -32600
+        bad = call("chain_getHeader", 999)
+        assert bad["error"]["code"] == -32602
+        assert call("system_accountNextIndex")["error"]["code"] == -32602
+        # dispatch failures come back as server errors, not transport 500s
+        err = call("author_submitExtrinsic", "alice", "no_such.call")
+        assert err["error"]["code"] == -32000
+    finally:
+        rpc.stop()
+
+
+def test_cli_key_tools_and_block_tools(tmp_path, capsys):
+    from cess_tpu.node.cli import main
+
+    # sign/verify round-trip (ref cli.rs key/sign/verify)
+    assert main(["sign", "--suri", "s1", "--message", "0xdeadbeef"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert main(["verify", "--public", out["public"],
+                 "--message", "0xdeadbeef",
+                 "--signature", out["signature"]]) == 0
+    capsys.readouterr()
+    assert main(["verify", "--public", out["public"],
+                 "--message", "0xbeef",
+                 "--signature", out["signature"]]) == 1
+
+    # produce a persisted dev chain, then drive the block tools
+    base = str(tmp_path / "data")
+    capsys.readouterr()
+    assert main(["run", "--dev", "--blocks", "5",
+                 "--base-path", base]) == 0
+    exp = str(tmp_path / "chain.blocks")
+    assert main(["export-blocks", "--dev", "--base-path", base,
+                 "--to", exp]) == 0
+    assert main(["check-block", "--dev", "--base-path", base,
+                 "--number", "3"]) == 0
+    chk = json.loads(capsys.readouterr().out)
+    assert chk["number"] == 3 and chk["verified"] is True
+
+    # import into a fresh base path reproduces the chain
+    base2 = str(tmp_path / "data2")
+    import os
+
+    os.makedirs(os.path.join(base2, "node-alice"), exist_ok=True)
+    assert main(["import-blocks", "--dev", "--base-path", base2,
+                 "--from", exp]) == 0
+    capsys.readouterr()
+    assert main(["check-block", "--dev", "--base-path", base2,
+                 "--number", "5"]) == 0
+    assert json.loads(capsys.readouterr().out)["verified"] is True
+
+    # revert drops unfinalized tail blocks (single dev authority:
+    # nothing finalizes, so revert is allowed)
+    assert main(["revert", "--dev", "--base-path", base,
+                 "--blocks", "2"]) == 0
+    capsys.readouterr()
+    assert main(["check-block", "--dev", "--base-path", base]) == 0
+    assert json.loads(capsys.readouterr().out)["number"] == 3
