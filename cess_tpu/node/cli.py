@@ -2,6 +2,8 @@
 (key/sign/verify), build-spec, check/export/import/revert blocks).
 
   python -m cess_tpu.node.cli --dev --blocks 20 --rpc-port 9944
+  python -m cess_tpu.node.cli --chain local --validator val0 \
+      --port 30333 --peers 30334,30335 --genesis-time 1700000000
   python -m cess_tpu.node.cli --chain local --validators 4 --blocks 50
   python -m cess_tpu.node.cli build-spec --chain dev
   python -m cess_tpu.node.cli key --suri my-seed
@@ -64,6 +66,19 @@ def main(argv=None) -> int:
                     help="import source file")
     ap.add_argument("--number", type=int, default=None,
                     help="block (check-block; default: head)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="run ONE node over TCP gossip on this port "
+                         "(production shape: one process per node)")
+    ap.add_argument("--peers", default="",
+                    help="comma-separated peer ports (TCP mode)")
+    ap.add_argument("--validator", default="",
+                    help="which genesis validator key this node holds "
+                         "(TCP mode; empty = full node, no authoring)")
+    ap.add_argument("--genesis-time", type=float, default=0.0,
+                    help="shared slot-epoch wall-clock instant (TCP "
+                         "mode; must match across all nodes)")
+    ap.add_argument("--slot-time", type=float, default=6.0,
+                    help="seconds per slot (TCP mode; ref block time 6s)")
     args = ap.parse_args(argv)
 
     def unhex(s: str) -> bytes:
@@ -102,6 +117,9 @@ def main(argv=None) -> int:
             print("--base-path required", file=sys.stderr)
             return 1
         return _block_tool(args, spec)
+
+    if args.port:
+        return _run_tcp_node(args, spec)
 
     nodes = [Node(spec, f"node-{v.account}",
                   {v.account: spec.session_key(v.account)},
@@ -143,6 +161,55 @@ def main(argv=None) -> int:
     return 0
 
 
+def _run_tcp_node(args, spec) -> int:
+    """Production-shaped deployment: ONE node per OS process, gossiping
+    over TCP (the reference's model; node/src/service.rs). Peers are
+    seeded via --peers and extended by the peer exchange."""
+    import os
+
+    from .net import NodeService
+
+    keystore = {}
+    if args.validator:
+        if args.validator not in {v.account for v in spec.validators}:
+            print(f"unknown validator {args.validator!r}", file=sys.stderr)
+            return 1
+        keystore[args.validator] = spec.session_key(args.validator)
+    name = args.validator or f"full-{args.port}"
+    base = os.path.join(args.base_path, f"node-{name}")         if args.base_path else None
+    node = Node(spec, name, keystore, base_path=base)
+    peers = [int(p) for p in args.peers.split(",") if p.strip()]
+    svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
+                      genesis_time=args.genesis_time)
+    rpc = None
+    if args.rpc_port:
+        rpc = RpcServer(node, port=args.rpc_port, lock=svc.lock).start()
+        print(f"JSON-RPC on 127.0.0.1:{rpc.port}", file=sys.stderr)
+    svc.start()
+    print(f"node {name} on :{args.port}, peers {peers}", file=sys.stderr)
+    try:
+        last = -1
+        while True:
+            time.sleep(max(args.slot_time, 0.2))
+            with svc.lock:
+                head = node.head()
+                fin = node.finalized
+            if head.number != last:
+                last = head.number
+                print(f"#{head.number} author={head.author} "
+                      f"finalized=#{fin} peers={len(svc._known_peers)}",
+                      file=sys.stderr)
+            if args.blocks and head.number >= args.blocks:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()
+        if rpc:
+            rpc.stop()
+    return 0
+
+
 def _block_tool(args, spec) -> int:
     """check/export/import/revert blocks (command.rs analogs). Each
     loads the node from --base-path (which replays + verifies the
@@ -152,16 +219,33 @@ def _block_tool(args, spec) -> int:
 
     from . import store as _store
 
-    base = os.path.join(args.base_path, f"node-{spec.validators[0].account}")
-    if not os.path.isdir(base):
-        # fall back to a direct node dir only if it actually IS one;
-        # otherwise create the canonical layout so a later `run
-        # --base-path` finds what we write here
-        if os.path.exists(os.path.join(args.base_path,
-                                       _store.BLOCKS_FILE)):
-            base = args.base_path
-        else:
-            os.makedirs(base, exist_ok=True)
+    # locate the node data dir: an existing node-* dir with a block
+    # log, the base path itself if it IS one, or (only for
+    # import-blocks, which creates data) the canonical layout — never
+    # silently fabricate an empty chain for read-only tools
+    candidates = sorted(
+        d for d in (os.listdir(args.base_path)
+                    if os.path.isdir(args.base_path) else [])
+        if d.startswith("node-")
+        and os.path.exists(os.path.join(args.base_path, d,
+                                        _store.BLOCKS_FILE)))
+    if candidates:
+        preferred = f"node-{spec.validators[0].account}"
+        base = os.path.join(args.base_path,
+                            preferred if preferred in candidates
+                            else candidates[0])
+        if len(candidates) > 1:
+            print(f"note: multiple node dirs {candidates}, using "
+                  f"{os.path.basename(base)}", file=sys.stderr)
+    elif os.path.exists(os.path.join(args.base_path, _store.BLOCKS_FILE)):
+        base = args.base_path
+    elif args.subcommand == "import-blocks":
+        base = os.path.join(args.base_path,
+                            f"node-{spec.validators[0].account}")
+        os.makedirs(base, exist_ok=True)
+    else:
+        print(f"no node data under {args.base_path}", file=sys.stderr)
+        return 1
     node = Node(spec, "tool", {}, base_path=base)
     head = node.head().number
 

@@ -21,7 +21,12 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
   ("status", (head_n, head_hash, finalized))  keepalive / sync trigger
   ("sync_request", from_number)    catch-up ask
   ("sync_response", (Block, ...))  canonical tail (capped)
-  ("just", Justification)          finality proof propagation
+  ("just", Justification)         finality proof propagation
+  ("peers", (port, ...))           peer exchange (discovery): each side
+                                   shares its known listen ports; unknown
+                                   ones get dialed — the reference's
+                                   Kademlia authority-discovery role
+                                   (service.rs:508-537), flood-simple
 """
 from __future__ import annotations
 
@@ -117,6 +122,10 @@ class NodeService:
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._seen: set[bytes] = set()   # gossip dedup (frame hashes)
+        # peer-exchange state lives here (NOT start()): inbound frames
+        # can arrive before start() finishes its own assignments
+        self._known_peers: set[int] = set(peers)
+        self.max_peers = 64   # discovery cap: bounds dial threads
         self.errors: list[str] = []      # swallowed faults, for tests/ops
         self._listener: socket.socket | None = None
 
@@ -131,6 +140,19 @@ class NodeService:
         for p in self.peer_ports:
             self._spawn(self._dial_loop, p)
         self._spawn(self._author_loop)
+
+    def _discover(self, ports) -> None:
+        """Peer exchange: dial newly learned listen ports. Bounded by
+        max_peers — an unauthenticated frame must not be able to spawn
+        unbounded dial threads."""
+        for p in ports:
+            if len(self._known_peers) >= self.max_peers:
+                return
+            if isinstance(p, int) and not isinstance(p, bool) \
+                    and 0 < p < 65536 \
+                    and p != self.port and p not in self._known_peers:
+                self._known_peers.add(p)
+                self._spawn(self._dial_loop, p)
 
     def stop(self) -> None:
         self._stop.set()
@@ -173,6 +195,8 @@ class NodeService:
             conn = _Conn(sock)
             self.conns.append(conn)
             self._send_status(conn)
+            self._send(conn, ("peers",
+                              (self.port, *sorted(self._known_peers))))
             self._recv_loop(conn)   # blocks until closed
             if conn in self.conns:
                 self.conns.remove(conn)
@@ -259,6 +283,9 @@ class NodeService:
                         and self.node.finality.verify_justification(payload):
                     self.node.finality.justifications[payload.round] = payload
                     self.node.on_justification(payload)
+        elif kind == "peers":
+            if isinstance(payload, tuple):
+                self._discover(payload)
         elif kind == "status":
             peer_head, _, _ = payload
             with self.lock:

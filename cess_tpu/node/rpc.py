@@ -104,6 +104,11 @@ class RpcServer:
                     except (TypeError, ValueError):
                         raise RpcError(INVALID_REQUEST,
                                        "bad Content-Length") from None
+                    if length < 0:
+                        # read(-1) would block until EOF, hanging the
+                        # handler thread on a kept-open socket
+                        raise RpcError(INVALID_REQUEST,
+                                       "bad Content-Length")
                     if length > MAX_BODY:
                         # drain (bounded) so the client can read the
                         # error envelope instead of a broken pipe

@@ -69,3 +69,24 @@ def test_signed_extrinsic_verify_and_tamper():
     # wire roundtrip preserves the signature
     back = codec.decode(xt.encoded())
     assert isinstance(back, SignedExtrinsic) and verify_signature(back, g)
+
+
+def test_depth_cap_encode_and_decode():
+    """Nesting beyond MAX_DEPTH is a CodecError on both sides — a
+    2 KiB proof blob must never blow the recursion limit (ADVICE r2)."""
+    import pytest
+
+    from cess_tpu import codec
+
+    deep = ()
+    for _ in range(codec.MAX_DEPTH + 2):
+        deep = (deep,)
+    with pytest.raises(codec.CodecError, match="nesting"):
+        codec.encode(deep)
+    # crafted wire bytes: 2000 nested one-element tuples
+    blob = bytes([6, 1]) * 2000 + bytes([0])
+    with pytest.raises(codec.CodecError, match="nesting"):
+        codec.decode(blob)
+    # legitimate protocol depth is far below the cap
+    ok = {"a": (1, [2, {"b": (3,)}])}
+    assert codec.decode(codec.encode(ok)) == ok

@@ -105,3 +105,55 @@ def test_lossy_link_still_converges():
     cluster."""
     _assert_converged(_run_cluster(duration=9.0, drop_every=3),
                       min_finalized=2)
+
+
+def _chain_worker(idx, ports, q, duration, genesis_time):
+    """Like _worker but each node initially knows ONLY its predecessor
+    (a chain topology): full connectivity must come from the peer
+    exchange."""
+    from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis
+    from cess_tpu.node.net import NodeService
+    from cess_tpu.node.network import Node
+
+    spec = ChainSpec(
+        name="t", chain_id="tcp-disc",
+        endowed=(("alice", 1_000_000_000 * D),),
+        validators=tuple(ValidatorGenesis(f"v{i}", 4_000_000 * D)
+                         for i in range(N)),
+        era_blocks=1000, epoch_blocks=1000, sudo="alice")
+    node = Node(spec, f"n{idx}", {f"v{idx}": spec.session_key(f"v{idx}")})
+    peers = [ports[idx - 1]] if idx > 0 else []
+    svc = NodeService(node, ports[idx], peers, slot_time=SLOT,
+                      genesis_time=genesis_time)
+    svc.start()
+    time.sleep(duration)
+    svc.stop()
+    with svc.lock:
+        q.put((idx, node.finalized,
+               [h.hash().hex() for h in node.chain],
+               len(svc._known_peers)))
+
+
+def test_peer_discovery_chain_topology():
+    """Node i only knows node i-1 at startup; the peer exchange must
+    build enough connectivity for votes from ALL authorities to reach
+    everyone (finality needs 2/3 of 3 = full vote flow)."""
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(N)
+    q = ctx.Queue()
+    genesis_time = time.time()
+    procs = [ctx.Process(target=_chain_worker,
+                         args=(i, ports, q, 7.0, genesis_time))
+             for i in range(N)]
+    for p in procs:
+        p.start()
+    results = sorted(q.get(timeout=90) for _ in range(N))
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    fins = [r[1] for r in results]
+    assert min(fins) >= 2, f"finality stalled: {fins}"
+    upto = min(fins)
+    assert len({tuple(r[2][:upto + 1]) for r in results}) == 1
+    # everyone learned the full peer set (2 others)
+    assert all(r[3] >= 2 for r in results), [r[3] for r in results]
