@@ -105,6 +105,27 @@ FEELESS = {
 }
 
 
+# Per-dispatch weights (fee units): the analog of the reference's
+# measured per-pallet weights.rs (SURVEY.md §6 "Extrinsic weights"),
+# coarsely tiered by the work a call does; unlisted calls weigh 0 and
+# pay only base + length fees.
+CALL_WEIGHTS = {
+    "file_bank.upload_declaration": 50,   # dedup scan + deal + assignment
+    "file_bank.transfer_report": 20,
+    "file_bank.upload_filler": 30,
+    "sminer.regnstk": 20,
+    "tee_worker.register": 40,            # chain + report verification
+    "storage_handler.buy_space": 10,
+    "storage_handler.expansion_space": 10,
+    "storage_handler.renewal_space": 10,
+    "staking.bond": 5, "staking.nominate": 5, "staking.validate": 5,
+    "council.close": 15,                  # may execute a motion
+    "treasury.propose_spend": 10, "treasury.propose_bounty": 10,
+    "evm.deploy": 30, "evm.call": 20,
+}
+WEIGHT_FEE = constants.TX_BYTE_FEE      # one weight unit == one byte
+
+
 @dataclasses.dataclass
 class RuntimeConfig:
     fragment_count: int = constants.FRAGMENT_COUNT
@@ -230,10 +251,13 @@ class Runtime:
         self.state.put("system", "genesis", h)
 
     def tx_fee(self, xt: SignedExtrinsic) -> int:
-        """base + per-byte length fee (TransactionPayment's role)."""
+        """base + per-byte length + per-call weight fee
+        (TransactionPayment's role; weights mirror the reference's
+        measured per-dispatch weights)."""
         if xt.call in FEELESS:
             return 0
-        return constants.TX_BASE_FEE + constants.TX_BYTE_FEE * len(xt)
+        return constants.TX_BASE_FEE + constants.TX_BYTE_FEE * len(xt) \
+            + WEIGHT_FEE * CALL_WEIGHTS.get(xt.call, 0)
 
     @staticmethod
     def _check_shape(xt: SignedExtrinsic) -> None:

@@ -75,8 +75,11 @@ def main(argv=None) -> int:
                     help="which genesis validator key this node holds "
                          "(TCP mode; empty = full node, no authoring)")
     ap.add_argument("--genesis-time", type=float, default=0.0,
-                    help="shared slot-epoch wall-clock instant (TCP "
-                         "mode; must match across all nodes)")
+                    help="shared slot-numbering wall-clock origin (TCP "
+                         "mode). Epoch numbering anchors at the first "
+                         "block's slot, so 0 (absolute unix slots) "
+                         "works; matching values across nodes keeps "
+                         "slot numbers aligned")
     ap.add_argument("--slot-time", type=float, default=6.0,
                     help="seconds per slot (TCP mode; ref block time 6s)")
     args = ap.parse_args(argv)

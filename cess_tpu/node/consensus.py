@@ -37,19 +37,31 @@ class Rrsc:
         self.c = c
         self.randomness: dict[int, bytes] = {0: b"genesis-randomness"}
         self._epoch_vrf: dict[int, list[bytes]] = {}
+        # epoch numbering is ANCHORED at the chain's first block slot
+        # (BABE records the genesis slot the same way): wall-clock slot
+        # numbers are huge (unix_time / slot_time), so absolute-slot
+        # epochs would be astronomically distant from epoch 0. The node
+        # pins this from block #1's claim; until then it floats with
+        # the trial slot so every pre-genesis claim sits in epoch 0.
+        self.genesis_slot: int | None = None
 
     # -- epochs ---------------------------------------------------------------
     def epoch_of(self, slot: int) -> int:
-        return slot // self.epoch_blocks
+        return max(0, slot - (self.genesis_slot or 0)) // self.epoch_blocks
 
     def epoch_randomness(self, epoch: int) -> bytes:
-        """Randomness for an epoch; derived lazily from collected VRF
-        outputs of epoch-1 (deterministic chain if none collected)."""
+        """Randomness for an epoch; derived lazily (and iteratively —
+        never recursion-bound) from collected VRF outputs of epoch-1
+        (deterministic chain if none collected)."""
         if epoch not in self.randomness:
-            prev = self.epoch_randomness(epoch - 1)
-            outs = b"".join(sorted(self._epoch_vrf.get(epoch - 1, [])))
-            self.randomness[epoch] = hashlib.sha256(
-                prev + epoch.to_bytes(8, "little") + outs).digest()
+            start = epoch
+            while start not in self.randomness:
+                start -= 1
+            for e in range(start + 1, epoch + 1):
+                outs = b"".join(sorted(self._epoch_vrf.get(e - 1, [])))
+                self.randomness[e] = hashlib.sha256(
+                    self.randomness[e - 1] + e.to_bytes(8, "little")
+                    + outs).digest()
         return self.randomness[epoch]
 
     def note_vrf(self, slot: int, output: bytes) -> None:

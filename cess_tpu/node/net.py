@@ -144,15 +144,18 @@ class NodeService:
     def _discover(self, ports) -> None:
         """Peer exchange: dial newly learned listen ports. Bounded by
         max_peers — an unauthenticated frame must not be able to spawn
-        unbounded dial threads."""
+        unbounded dial threads. Membership check+add runs under the
+        service lock (concurrent recv threads must not double-dial)."""
         for p in ports:
-            if len(self._known_peers) >= self.max_peers:
-                return
-            if isinstance(p, int) and not isinstance(p, bool) \
-                    and 0 < p < 65536 \
-                    and p != self.port and p not in self._known_peers:
+            if not (isinstance(p, int) and not isinstance(p, bool)
+                    and 0 < p < 65536 and p != self.port):
+                continue
+            with self.lock:
+                if len(self._known_peers) >= self.max_peers \
+                        or p in self._known_peers:
+                    continue
                 self._known_peers.add(p)
-                self._spawn(self._dial_loop, p)
+            self._spawn(self._dial_loop, p)
 
     def stop(self) -> None:
         self._stop.set()

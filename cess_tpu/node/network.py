@@ -271,6 +271,10 @@ class Node:
         for account, key in self.keystore.items():
             if account not in self.authorities:
                 continue
+            if self.head().number == 0:
+                # pre-genesis: the epoch anchor floats with the trial
+                # slot; it pins permanently at block #1 (import/adopt)
+                self.rrsc.genesis_slot = slot
             claim = self.rrsc.claim_slot(slot, account, key, self.authorities)
             if claim is None:
                 continue
@@ -291,6 +295,8 @@ class Node:
 
     def commit_proposal(self) -> None:
         header, extrinsics, (block0, events0) = self._proposal
+        if header.number == 1:
+            self.rrsc.genesis_slot = header.claim.slot
         undo = self.runtime.state.commit_tx_undo()
         self._proposal = None
         self._adopt_block(Block(header=header, extrinsics=extrinsics),
@@ -390,6 +396,10 @@ class Node:
                              f"with finality at #{self.finalized}")
         public = self.spec.session_key(header.author).public
         authorities = self.authorities_at(header.parent)
+        if header.number == 1:
+            # epoch numbering anchors at the chain's first slot; pin it
+            # BEFORE verification so author and importers agree
+            self.rrsc.genesis_slot = header.claim.slot
         if not self.rrsc.verify_claim(header.claim, public, authorities):
             raise ValueError(f"{self.name}: bad slot claim")
         if header.parent == self.head().hash():
@@ -449,6 +459,8 @@ class Node:
         state.truncate_history(rec.block_before)
         state.events[:] = rec.events_before
         self.authorities = rec.authorities_before
+        if head.number == 1:
+            self.rrsc.genesis_slot = None   # re-pins with the next block 1
         if rec.vrf_note is not None:
             epoch, output = rec.vrf_note
             outs = self.rrsc._epoch_vrf.get(epoch, [])

@@ -105,6 +105,7 @@ def write_snapshot(base_path: str, node) -> None:
         tuple(node.authorities),
         node.finalized,
         dict(node.finality.justifications),
+        node.rrsc.genesis_slot,
     ))
     tmp = os.path.join(base_path, SNAPSHOT_FILE + ".tmp")
     with open(tmp, "wb") as f:
@@ -127,7 +128,8 @@ def load_snapshot(base_path: str, node) -> bool:
         return False
     try:
         (chain, kv, block, randomness, epoch_vrf, authorities,
-         finalized, justifications) = codec.decode(raw[len(_MAGIC):])
+         finalized, justifications,
+         genesis_slot) = codec.decode(raw[len(_MAGIC):])
     except (codec.CodecError, ValueError):
         return False
     state = node.runtime.state
@@ -162,4 +164,5 @@ def load_snapshot(base_path: str, node) -> bool:
     node.finalized = finalized
     node.finality.justifications = {int(k): v
                                     for k, v in justifications.items()}
+    node.rrsc.genesis_slot = genesis_slot
     return True

@@ -644,3 +644,24 @@ def test_audit_stale_proposal_votes_do_not_count(rt):
     rt.apply_extrinsic("v1", "audit.save_challenge_info", net, miners,
                        sign_proposal(keys["v1"], net, miners))
     assert rt.audit.challenge() is not None
+
+
+def test_weight_based_fees():
+    """Per-dispatch weights feed the fee (the reference's weights.rs
+    role): a heavy call costs more than a plain transfer of the same
+    encoded size order; feeless operational calls stay free."""
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.chain.runtime import CALL_WEIGHTS, WEIGHT_FEE, Runtime
+    from cess_tpu.crypto import ed25519
+
+    rt2 = Runtime()
+    key = ed25519.SigningKey.generate(b"w")
+    g = rt2.genesis_hash()
+    light = sign_extrinsic(key, g, "w", 0, "balances.transfer", ("x", 1))
+    heavy = sign_extrinsic(key, g, "w", 0, "sminer.regnstk",
+                           ("w", b"p", 1))
+    extra = rt2.tx_fee(heavy) - rt2.tx_fee(light)
+    assert extra >= WEIGHT_FEE * CALL_WEIGHTS["sminer.regnstk"] \
+        - WEIGHT_FEE * 16   # length difference margin
+    feeless = sign_extrinsic(key, g, "w", 0, "im_online.heartbeat", ())
+    assert rt2.tx_fee(feeless) == 0

@@ -170,3 +170,38 @@ def test_tag_oracle_parity_numpy_bigint():
     for b in range(m.shape[0]):
         want = (int(f[b]) + sum(int(a) * int(x) for a, x in zip(alpha, m[b]))) % pf.P
         assert int(tags[b]) == want
+
+
+def test_audit_backend_gate():
+    """The AuditBackend half of the north-star trait pair: cpu default
+    and device-pinned variants compute IDENTICAL results (platform
+    determinism is a protocol invariant)."""
+    import numpy as np
+
+    from cess_tpu.ops import podr2
+    from cess_tpu.ops.audit_backend import make_audit_backend
+
+    key = podr2.Podr2Key.generate(3)
+    cpu = make_audit_backend(key, "cpu")
+    auto = make_audit_backend(key, "auto")
+    rng = np.random.default_rng(0)
+    frags = rng.integers(0, 256, (4, 2048), dtype=np.uint8)
+    ids = np.arange(4, dtype=np.uint32)
+    blocks = 2048 // podr2.BLOCK_BYTES
+    tags_a = np.asarray(cpu.tag_fragments(ids, frags))
+    tags_b = np.asarray(auto.tag_fragments(ids, frags))
+    assert np.array_equal(tags_a, tags_b)
+    idx, nu = cpu.gen_challenge(b"round", blocks)
+    mu, sigma = cpu.prove_batch(frags, tags_a, idx, nu)
+    ok = np.asarray(cpu.verify_batch(ids, blocks, idx, nu, mu, sigma))
+    assert ok.all()
+    # aggregated constant-size proof path
+    ids2 = np.stack([ids, np.zeros(4, np.uint32)], axis=1)
+    r = cpu.aggregate_coeffs(b"round", ids2)
+    mu_t, sg_t = cpu.prove_aggregate(frags, tags_a, idx, nu, r)
+    assert bool(np.asarray(cpu.verify_aggregate(
+        ids2, blocks, idx, nu, r, mu_t, sg_t)))
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown AuditBackend"):
+        make_audit_backend(key, "quantum")
