@@ -22,6 +22,10 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
   ("sync_request", from_number)    catch-up ask
   ("sync_response", (Block, ...))  canonical tail (capped)
   ("just", Justification)         finality proof propagation
+  ("warp_request", 0)              checkpoint-sync ask (fresh nodes)
+  ("warp_response", (snapshot_payload_bytes, Justification))
+                                   snapshot + finality countersignatures
+                                   (verified by Node.warp_sync logic)
   ("peers", (port, ...))           peer exchange (discovery): each side
                                    shares its known listen ports; unknown
                                    ones get dialed — the reference's
@@ -43,6 +47,7 @@ _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
 SYNC_BATCH = 64
 SYNC_LOOKBACK = 8   # re-request a short tail to cover small forks
+WARP_THRESHOLD = 50  # finalized blocks behind which a fresh node warps
 
 
 @dataclasses.dataclass
@@ -127,6 +132,7 @@ class NodeService:
         self._known_peers: set[int] = set(peers)
         self.max_peers = 64   # discovery cap: bounds dial threads
         self.errors: list[str] = []      # swallowed faults, for tests/ops
+        self._warp_tries = 0
         self._listener: socket.socket | None = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -217,7 +223,7 @@ class NodeService:
                 msg = codec.decode(raw)
                 self._handle(msg, conn)
             except (codec.CodecError, ValueError, DispatchError,
-                    TypeError, KeyError):
+                    TypeError, KeyError, AttributeError, IndexError):
                 # malformed or stale traffic from a peer must never
                 # kill the service
                 continue
@@ -290,12 +296,38 @@ class NodeService:
             if isinstance(payload, tuple):
                 self._discover(payload)
         elif kind == "status":
-            peer_head, _, _ = payload
+            peer_head, _, peer_fin = payload
             with self.lock:
                 ours = self.node.head().number
-            if peer_head > ours:
+            if ours == 0 and peer_fin > WARP_THRESHOLD \
+                    and self._warp_tries < 3:
+                # fresh node far behind a finalized peer: checkpoint
+                # sync instead of replaying the whole chain; after a
+                # few failed attempts fall back to full replay sync
+                self._warp_tries += 1
+                self._send(conn, ("warp_request", 0))
+            elif peer_head > ours:
                 self._send(conn, ("sync_request",
                                   max(1, ours - SYNC_LOOKBACK)))
+        elif kind == "warp_request":
+            from . import store as _store
+
+            with self.lock:
+                if not self.node.finality.justifications:
+                    return
+                rnd = max(self.node.finality.justifications)
+                just = self.node.finality.justifications[rnd]
+                payload_bytes = _store.snapshot_payload(self.node)
+            self._send(conn, ("warp_response", (payload_bytes, just)))
+        elif kind == "warp_response":
+            snap_bytes, just = payload
+            from .finality import Justification
+
+            if not isinstance(snap_bytes, bytes) \
+                    or not isinstance(just, Justification):
+                return
+            with self.lock:
+                self._try_warp(snap_bytes, just)
         elif kind == "sync_request":
             with self.lock:
                 blocks = []
@@ -325,6 +357,37 @@ class NodeService:
                         "sync_request",
                         max(1, self.node.head().number - SYNC_LOOKBACK)))
                 return False
+
+    def _try_warp(self, snap_bytes: bytes, just) -> bool:
+        """Verify + adopt a checkpoint (caller holds the lock): same
+        trust model as Node.warp_sync_from, over the wire."""
+        from . import store as _store
+        from .network import Node as _Node
+
+        node = self.node
+        if node.head().number != 0:
+            return False
+        probe = _Node(node.spec, f"{node.name}-warp", {})
+        if not _store.restore_snapshot_payload(probe, snap_bytes):
+            return False
+        chain = probe.chain
+        if chain[0].hash() != node.chain[0].hash():
+            return False
+        for parent, child in zip(chain, chain[1:]):
+            if child.parent != parent.hash()                     or child.number != parent.number + 1:
+                return False
+        if not (0 < just.target_number < len(chain)
+                and chain[just.target_number].hash() == just.target_hash):
+            return False
+        if not probe.finality.verify_justification(just):
+            return False
+        if not _store.restore_snapshot_payload(node, snap_bytes):
+            return False
+        node.finality.justifications[just.round] = just
+        node.finalized = max(node.finalized, just.target_number)
+        if node.store is not None:
+            _store.write_snapshot(node.base_path, node)
+        return True
 
     def _after_chain_move(self) -> None:
         """Cast + gossip finality votes and any new justification."""

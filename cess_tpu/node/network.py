@@ -202,6 +202,59 @@ class Node:
                 self.on_justification(just)
         return imported
 
+    def warp_sync_from(self, peer: "Node") -> bool:
+        """Checkpoint (warp) sync: adopt the peer's state snapshot
+        WITHOUT replaying the chain — the reference's warp-sync role
+        (service.rs:259-263), shaped like production checkpoint sync.
+
+        Trust model (verified before adoption, in this order):
+        1. the snapshot's header chain starts at OUR locally-computed
+           genesis (same spec => same genesis hash) and is parent-
+           linked with consecutive numbers throughout;
+        2. the snapshot KV re-derives the head header's state root
+           (restore_snapshot_payload enforces this);
+        3. the peer's newest justification targets a block ON that
+           chain and carries >= 2/3 valid signatures from the
+           authority set + session keys recorded IN the adopted state.
+        Skipped (the warp trade-off, same as the reference's): per-
+        block claim verification and execution. A fabricated snapshot
+        must therefore forge 2/3 of finality signatures to be adopted.
+        Only meaningful on a fresh node (no local progress). The TCP
+        transport runs the same checks over the wire
+        (net.NodeService._try_warp)."""
+        from . import store as _store
+
+        if self.head().number != 0:
+            return False
+        if not peer.finality.justifications:
+            return False
+        payload = _store.snapshot_payload(peer)
+        snap_node = Node(self.spec, f"{self.name}-warp", {})
+        if not _store.restore_snapshot_payload(snap_node, payload):
+            return False
+        chain = snap_node.chain
+        if chain[0].hash() != self.chain[0].hash():
+            return False   # different genesis: not our chain
+        for parent, child in zip(chain, chain[1:]):
+            if child.parent != parent.hash() \
+                    or child.number != parent.number + 1:
+                return False
+        rnd = max(peer.finality.justifications)
+        just = peer.finality.justifications[rnd]
+        if not (0 < just.target_number < len(chain)
+                and chain[just.target_number].hash() == just.target_hash):
+            return False
+        if not snap_node.finality.verify_justification(just):
+            return False
+        # adopt wholesale (state root already proven against the head)
+        if not _store.restore_snapshot_payload(self, payload):
+            return False
+        self.finality.justifications[rnd] = just
+        self.finalized = max(self.finalized, just.target_number)
+        if self.store is not None:
+            _store.write_snapshot(self.base_path, self)
+        return True
+
     # -- tx pool ---------------------------------------------------------------
     def queue_heartbeats(self) -> list[SignedExtrinsic]:
         """im-online OCW analog shared by both network drivers: queue
@@ -396,11 +449,19 @@ class Node:
                              f"with finality at #{self.finalized}")
         public = self.spec.session_key(header.author).public
         authorities = self.authorities_at(header.parent)
-        if header.number == 1:
+        if header.number == 1 and self.rrsc.genesis_slot is None:
             # epoch numbering anchors at the chain's first slot; pin it
-            # BEFORE verification so author and importers agree
+            # BEFORE verification so author and importers agree. Only
+            # an UNPINNED node pins here — a competing block #1 on a
+            # progressed node must not re-anchor epochs (that would
+            # poison every later claim); restore on verify failure so
+            # a junk claim cannot pin garbage
             self.rrsc.genesis_slot = header.claim.slot
-        if not self.rrsc.verify_claim(header.claim, public, authorities):
+            if not self.rrsc.verify_claim(header.claim, public,
+                                          authorities):
+                self.rrsc.genesis_slot = None
+                raise ValueError(f"{self.name}: bad slot claim")
+        elif not self.rrsc.verify_claim(header.claim, public, authorities):
             raise ValueError(f"{self.name}: bad slot claim")
         if header.parent == self.head().hash():
             self._apply_to_head(block, persist=_persist)
@@ -496,6 +557,10 @@ class Node:
             self._rewind_one()
         try:
             for i, h in enumerate(reversed(path)):
+                if self.bodies[h].header.number == 1:
+                    # adopting a different block #1: re-anchor epochs
+                    self.rrsc.genesis_slot = \
+                        self.bodies[h].header.claim.slot
                 # agents fire once, on the new head, not per replayed block
                 self._apply_to_head(self.bodies[h], persist=persist,
                                     fire_agents=(i == len(path) - 1))

@@ -94,9 +94,10 @@ SNAPSHOT_FILE = "snapshot.bin"
 BLOCKS_FILE = "blocks.bin"
 
 
-def write_snapshot(base_path: str, node) -> None:
-    """Atomic full-node checkpoint (tmp + rename)."""
-    payload = codec.encode((
+def snapshot_payload(node) -> bytes:
+    """The checkpoint wire/disk payload (shared by on-disk snapshots
+    and warp sync)."""
+    return codec.encode((
         tuple(node.chain),
         node.runtime.state.kv,
         node.runtime.state.block,
@@ -107,6 +108,11 @@ def write_snapshot(base_path: str, node) -> None:
         dict(node.finality.justifications),
         node.rrsc.genesis_slot,
     ))
+
+
+def write_snapshot(base_path: str, node) -> None:
+    """Atomic full-node checkpoint (tmp + rename)."""
+    payload = snapshot_payload(node)
     tmp = os.path.join(base_path, SNAPSHOT_FILE + ".tmp")
     with open(tmp, "wb") as f:
         f.write(_MAGIC + payload)
@@ -126,10 +132,15 @@ def load_snapshot(base_path: str, node) -> bool:
         raw = f.read()
     if not raw.startswith(_MAGIC):
         return False
+    return restore_snapshot_payload(node, raw[len(_MAGIC):])
+
+
+def restore_snapshot_payload(node, payload: bytes) -> bool:
+    """Decode + integrity-check a checkpoint payload into ``node``."""
     try:
         (chain, kv, block, randomness, epoch_vrf, authorities,
          finalized, justifications,
-         genesis_slot) = codec.decode(raw[len(_MAGIC):])
+         genesis_slot) = codec.decode(payload)
     except (codec.CodecError, ValueError):
         return False
     state = node.runtime.state
@@ -158,6 +169,10 @@ def load_snapshot(base_path: str, node) -> bool:
         node.headers[h] = hd
         prev_primaries += 1 if (hd.claim and hd.claim.vrf) else 0
         node._primaries[h] = prev_primaries
+        # checkpoint approximation: historical per-block authority
+        # sets are not in the snapshot; stamp the restored set (exact
+        # for the head, which is what finality verification targets)
+        node._authset[h] = tuple(authorities)
     node.rrsc.randomness = {int(k): v for k, v in randomness.items()}
     node.rrsc._epoch_vrf = {int(k): list(v) for k, v in epoch_vrf.items()}
     node.authorities = tuple(authorities)

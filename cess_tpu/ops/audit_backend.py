@@ -70,6 +70,12 @@ def _device_for(backend: str):
     if backend == "cpu":
         return jax.devices("cpu")[0]
     if backend in ("tpu", "jax"):
+        if jax.default_backend() == "cpu":
+            # an EXPLICIT accelerator request must fail loudly, not
+            # silently run the audit batch on CPU
+            raise RuntimeError(
+                "AuditBackend 'tpu' requested but no accelerator is "
+                "present; use 'cpu' or 'auto'")
         return jax.devices()[0]
     raise ValueError(f"unknown AuditBackend {backend!r}")
 

@@ -173,3 +173,39 @@ def test_bogus_equivocation_reports_rejected():
     uy = sign_vote(kx, g, "nobody", 90, b"\xbb" * 32, 90)
     with pytest.raises(DispatchError, match="UnknownVoter"):
         rt.apply_extrinsic("alice", "offences.report_equivocation", ux, uy)
+
+
+def test_warp_sync_checkpoint():
+    """Checkpoint/warp sync: a fresh node adopts a long peer's state
+    from a snapshot + finality countersignatures, with no replay; a
+    tampered snapshot or missing justification is refused."""
+    spec, nodes = make_nodes(3, chain_id="warp-net")
+    net = Network(nodes)
+    net.run_slots(12)
+    peer = nodes[0]
+    assert peer.finalized >= 11
+
+    fresh = Node(spec, "warped", {})
+    assert fresh.warp_sync_from(peer) is True
+    assert fresh.head().hash() == peer.head().hash()
+    assert fresh.finalized == peer.finalized
+    assert fresh.runtime.state.state_root() \
+        == peer.runtime.state.state_root()
+    # warp means NO replay: no bodies/undo logs for historical blocks
+    assert 1 not in fresh.block_bodies and not fresh._undo
+    # the warped node now participates normally
+    merged = Network([*nodes, fresh])
+    merged.run_slots(2)
+    assert fresh.chain[-1].hash() == peer.chain[-1].hash()
+
+    # a node with local progress refuses warp (full sync instead)
+    assert peer.warp_sync_from(nodes[1]) is False
+    # no justifications -> refuse
+    lone = Node(spec, "lone", {"v0": spec.session_key("v0")})
+    fresh2 = Node(spec, "f2", {})
+    assert fresh2.warp_sync_from(lone) is False
+    # wrong chain (different genesis) -> refuse
+    other_spec, other_nodes = make_nodes(3, chain_id="warp-other")
+    Network(other_nodes).run_slots(3)
+    fresh3 = Node(spec, "f3", {})
+    assert fresh3.warp_sync_from(other_nodes[0]) is False
