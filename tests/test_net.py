@@ -181,9 +181,10 @@ def _warp_worker(idx, ports, q, genesis_time):
                       genesis_time=genesis_time)
     if idx == 2:
         _net.WARP_THRESHOLD = 5   # warp sooner in the test
-        time.sleep(3.0)           # join late, well past the threshold
+        time.sleep(7.0)           # join late, well past the threshold
+        # (generous margins: the 1-vCPU CI box runs 3 interpreters)
     svc.start()
-    deadline = time.time() + (8.0 if idx < 2 else 4.0)
+    deadline = time.time() + (14.0 if idx < 2 else 7.0)
     while time.time() < deadline:
         time.sleep(0.2)
     svc.stop()
