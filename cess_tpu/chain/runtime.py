@@ -68,8 +68,8 @@ SIGNED_CALLS = {
     "oss.register", "oss.update", "oss.destroy",
     "oss.authorize", "oss.cancel_authorize",
     "cacher.register", "cacher.update", "cacher.logout", "cacher.pay",
-    "staking.bond", "staking.unbond", "staking.validate", "staking.chill",
-    "staking.nominate",
+    "staking.bond", "staking.unbond", "staking.withdraw_unbonded",
+    "staking.validate", "staking.chill", "staking.nominate",
     "im_online.heartbeat",
     "council.propose", "council.vote", "council.close",
     "treasury.propose_spend", "treasury.propose_bounty",

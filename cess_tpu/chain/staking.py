@@ -34,6 +34,8 @@ TREASURY = "treasury"
 MIN_VALIDATOR_BOND = 1_000_000 * constants.DOLLARS   # runtime :585-589
 MIN_NOMINATOR_BOND = 1_000 * constants.DOLLARS       # genesis min_nominator_bond analog (pallet/mod.rs:313,638)
 ERAS_PER_YEAR = 365 * 4   # 6-hour eras (1h epochs x 6 sessions)
+BONDING_DURATION_ERAS = 4 * 28    # 28 days (runtime/src/lib.rs:562)
+MAX_UNLOCKING_CHUNKS = 32
 
 
 @codec.register
@@ -60,14 +62,51 @@ class Staking:
         self.state.deposit_event(PALLET, "Bonded", who=who, amount=amount)
 
     def unbond(self, who: str, amount: int) -> None:
+        """Active bond -> an unlocking chunk released BondingDuration
+        eras later by withdraw_unbonded (ref BondingDuration = 112
+        eras, runtime/src/lib.rs:562; MaxUnlockingChunks cap). Funds
+        stay reserved — and slashable — until withdrawn."""
         b = self.bonded(who)
-        if amount <= 0 or amount > b:
+        if not isinstance(amount, int) or amount <= 0 or amount > b:
             raise DispatchError("staking.InvalidAmount")
         if who in self.validators() and b - amount < MIN_VALIDATOR_BOND:
             raise DispatchError("staking.InsufficientBond",
                                 "would fall below MinValidatorBond")
-        self.balances.unreserve(who, amount)
+        chunks = self.state.get(PALLET, "unlocking", who, default=())
+        unlock_era = self.current_era() + BONDING_DURATION_ERAS
+        if chunks and chunks[-1][1] == unlock_era:
+            # merge same-era unbonds into one chunk (Substrate does;
+            # otherwise repeated small unbonds exhaust the chunk cap)
+            chunks = chunks[:-1] + ((chunks[-1][0] + amount, unlock_era),)
+        elif len(chunks) >= MAX_UNLOCKING_CHUNKS:
+            raise DispatchError("staking.NoMoreChunks")
+        else:
+            chunks = chunks + ((amount, unlock_era),)
+        self.state.put(PALLET, "unlocking", who, chunks)
         self.state.put(PALLET, "bond", who, b - amount)
+        self.state.deposit_event(PALLET, "Unbonded", who=who,
+                                 amount=amount, unlock_era=unlock_era)
+
+    def withdraw_unbonded(self, who: str) -> int:
+        """Release every unlocking chunk whose era has passed
+        (withdraw_unbonded, pallet/mod.rs:716). Returns the amount."""
+        chunks = self.state.get(PALLET, "unlocking", who, default=())
+        if not chunks:
+            raise DispatchError("staking.NoUnlockChunk", who)
+        era = self.current_era()
+        due = sum(a for a, e in chunks if e <= era)
+        left = tuple((a, e) for a, e in chunks if e > era)
+        if due:
+            self.balances.unreserve(who, due)
+        if left:
+            self.state.put(PALLET, "unlocking", who, left)
+        else:
+            self.state.delete(PALLET, "unlocking", who)
+        self.state.deposit_event(PALLET, "Withdrawn", who=who, amount=due)
+        return due
+
+    def unlocking(self, who: str) -> tuple:
+        return self.state.get(PALLET, "unlocking", who, default=())
 
     def bonded(self, who: str) -> int:
         return self.state.get(PALLET, "bond", who, default=0)
@@ -207,24 +246,46 @@ class Staking:
         return self.state.get(PALLET, "era", default=0)
 
     # -- offence slashing ---------------------------------------------------------
-    def _slash_one(self, who: str, permill: int) -> int:
+    def _drain(self, who: str, amount: int) -> int:
+        """Take up to ``amount`` from active bond first, then from
+        unlocking chunks oldest-first (Substrate slashes the ledger
+        including unlocking — queued withdrawals stay liable)."""
+        taken = 0
         b = self.bonded(who)
-        taken = b * permill // 1000
+        from_bond = min(b, amount)
+        if from_bond:
+            self.state.put(PALLET, "bond", who, b - from_bond)
+            taken += from_bond
+        if taken < amount:
+            chunks = list(self.state.get(PALLET, "unlocking", who,
+                                         default=()))
+            kept = []
+            for a, e in chunks:
+                cut = min(a, amount - taken)
+                taken += cut
+                if a - cut:
+                    kept.append((a - cut, e))
+            if kept:
+                self.state.put(PALLET, "unlocking", who, tuple(kept))
+            else:
+                self.state.delete(PALLET, "unlocking", who)
         if taken:
-            self.state.put(PALLET, "bond", who, b - taken)
             self.balances.slash_reserved(who, taken, TREASURY)
+        return taken
+
+    def _slash_one(self, who: str, permill: int) -> int:
+        want = (self.bonded(who)
+                + sum(a for a, _ in self.unlocking(who))) * permill // 1000
+        taken = self._drain(who, want)
         self.state.deposit_event(PALLET, "Slashed", who=who, amount=taken,
                                  permill=permill)
         return taken
 
     def _slash_amount(self, who: str, amount: int) -> int:
-        """Take up to ``amount`` from the bond (exposure-based slash:
-        the EXPOSED stake is liable, capped by what is still bonded)."""
-        b = self.bonded(who)
-        taken = min(b, amount)
-        if taken:
-            self.state.put(PALLET, "bond", who, b - taken)
-            self.balances.slash_reserved(who, taken, TREASURY)
+        """Take up to ``amount`` from active bond + unlocking chunks
+        (exposure-based slash: the EXPOSED stake is liable, wherever
+        it currently sits in the ledger)."""
+        taken = self._drain(who, amount)
         self.state.deposit_event(PALLET, "Slashed", who=who, amount=taken,
                                  permill=0)
         return taken
@@ -241,8 +302,10 @@ class Staking:
         e = self.exposure(self.current_era() if era is None else era, who)
         if e is None:
             taken = self._slash_one(who, permill)
-            for nom, amount in self.nominators_of(who):
-                taken += self._slash_amount(nom, amount * permill // 1000)
+            for nom, _ in self.nominators_of(who):
+                # fraction of the nominator's WHOLE ledger (active +
+                # unlocking): queued withdrawals stay liable here too
+                taken += self._slash_one(nom, permill)
             return taken
         taken = self._slash_amount(who, e.own * permill // 1000)
         for nom, amount in e.nominators:
@@ -251,12 +314,10 @@ class Staking:
 
     # -- scheduler slash (slashing.rs:694-705) ------------------------------------
     def slash_scheduler(self, stash: str) -> None:
-        """5% of MinValidatorBond from the stash's bond -> treasury."""
+        """5% of MinValidatorBond from the stash's ledger (active bond
+        first, then unlocking chunks — unbonding does not shelter a
+        misbehaving scheduler's stake) -> treasury."""
         amount = MIN_VALIDATOR_BOND * constants.SCHEDULER_SLASH_PERMILL // 1000
-        b = self.bonded(stash)
-        taken = min(b, amount)
-        if taken:
-            self.state.put(PALLET, "bond", stash, b - taken)
-            self.balances.slash_reserved(stash, taken, TREASURY)
+        taken = self._drain(stash, amount)
         self.state.deposit_event(PALLET, "SchedulerSlashed", stash=stash,
                                  amount=taken)

@@ -158,3 +158,52 @@ def test_heartbeat_requires_authority(rt):
     rt.fund("rando", 100 * D)
     with pytest.raises(DispatchError, match="NotAuthority"):
         rt.apply_extrinsic("rando", "im_online.heartbeat")
+
+
+def test_bonding_duration_and_slashable_unlocking(rt):
+    """Unbonded funds wait BondingDuration eras before withdrawal and
+    remain slashable while queued (ref BondingDuration=112 eras,
+    runtime/src/lib.rs:562; Substrate slashes the whole ledger)."""
+    from cess_tpu.chain.staking import BONDING_DURATION_ERAS
+
+    free0 = rt.balances.free("nom1")
+    rt.apply_extrinsic("nom1", "staking.nominate", "v1")
+    rt.advance_blocks(ERA)
+    rt.apply_extrinsic("nom1", "staking.unbond", 500_000 * D)
+    assert rt.balances.free("nom1") == free0          # still reserved
+    with pytest.raises(DispatchError, match="InvalidAmount"):
+        rt.apply_extrinsic("nom1", "staking.unbond", 2_000_000 * D)
+    # cannot withdraw before the duration elapses
+    rt.apply_extrinsic("nom1", "staking.withdraw_unbonded")
+    assert rt.balances.free("nom1") == free0
+    # a slash drains active bond AND the queued chunk
+    b_active = rt.staking.bonded("nom1")              # 1.5M
+    rt.staking.slash_fraction("v1", 500)              # 50% of exposure
+    # exposed 2M * 50% = 1M owed: active bond drains FIRST
+    # (1.5M - 1M = 500k left active; the queued 500k chunk untouched)
+    assert rt.staking.bonded("nom1") == 500_000 * D
+    assert rt.staking.unlocking("nom1") == ((500_000 * D, 1 + 112),)
+    # fast-forward past the bonding duration: remaining chunk releases
+    era_target = rt.staking.current_era() + BONDING_DURATION_ERAS
+    while rt.staking.current_era() < era_target:
+        rt.advance_blocks(ERA)
+    rt.apply_extrinsic("nom1", "staking.withdraw_unbonded")
+    total_left = rt.staking.bonded("nom1") \
+        + sum(a for a, _ in rt.staking.unlocking("nom1"))
+    assert rt.balances.reserved("nom1") == total_left
+
+
+def test_same_era_unbonds_merge_and_unbonded_scheduler_still_slashed(rt):
+    from cess_tpu.chain.staking import MAX_UNLOCKING_CHUNKS
+
+    for _ in range(MAX_UNLOCKING_CHUNKS + 5):   # same era: one chunk
+        rt.apply_extrinsic("nom1", "staking.unbond", 1_000 * D)
+    assert len(rt.staking.unlocking("nom1")) == 1
+    # a fully-unbonded TEE scheduler stash is STILL slashable
+    rt.fund("stash9", 2_000_000 * D)
+    rt.apply_extrinsic("stash9", "staking.bond", 1_500_000 * D)
+    rt.apply_extrinsic("stash9", "staking.unbond", 1_500_000 * D)
+    assert rt.staking.bonded("stash9") == 0
+    r0 = rt.balances.reserved("stash9")
+    rt.staking.slash_scheduler("stash9")
+    assert rt.balances.reserved("stash9") < r0
