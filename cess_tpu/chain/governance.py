@@ -43,6 +43,7 @@ COUNCIL_CALLS = {
     "council.set_members",
     "system.retire_sudo",
     "system.apply_runtime_upgrade",
+    "staking.cancel_deferred_slash",
 }
 
 

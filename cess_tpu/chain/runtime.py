@@ -134,6 +134,9 @@ class RuntimeConfig:
     audit_challenge_life: int | None = None  # default: audit module constant
     audit_verify_life: int | None = None
     genesis_spec_version: int = 0   # 0 -> current code version
+    # reference defers offence slashes 28 eras (runtime :563); 0 =
+    # immediate (dev/test default — deferral is config opt-in)
+    slash_defer_eras: int = 0
 
 
 class Runtime:
@@ -147,7 +150,8 @@ class Runtime:
         self.scheduler = Scheduler(s)
         self.oss = Oss(s)
         self.cacher = Cacher(s, self.balances)
-        self.staking = Staking(s, self.balances)
+        self.staking = Staking(s, self.balances,
+                               slash_defer_eras=self.config.slash_defer_eras)
         self.credit = SchedulerCredit(
             s, self.config.credit_period_blocks or self.config.era_blocks)
         self.tee_worker = TeeWorker(s, staking=self.staking,
@@ -361,6 +365,9 @@ class Runtime:
             era = self.staking.current_era()
             self.im_online.era_check(era)
             self.staking.end_era(era)
+            # due slashes land at the START of their apply_era, before
+            # the new era's exposures are captured
+            self.staking.apply_due_slashes()
             self.treasury_pallet.on_spend_period()
             self.staking.capture_exposures(era + 1)
             self.sminer.release_reward_tranches()

@@ -36,6 +36,10 @@ MIN_NOMINATOR_BOND = 1_000 * constants.DOLLARS       # genesis min_nominator_bon
 ERAS_PER_YEAR = 365 * 4   # 6-hour eras (1h epochs x 6 sessions)
 BONDING_DURATION_ERAS = 4 * 28    # 28 days (runtime/src/lib.rs:562)
 MAX_UNLOCKING_CHUNKS = 32
+# the reference defers offence slashes by 28 eras so governance can
+# cancel wrongful ones (SlashDeferDuration = 4 * 7, runtime :563);
+# configurable here — 0 applies immediately
+SLASH_DEFER_ERAS_REF = 4 * 7
 
 
 @codec.register
@@ -49,9 +53,18 @@ class Exposure:
 
 
 class Staking:
-    def __init__(self, state: State, balances: Balances):
+    def __init__(self, state: State, balances: Balances,
+                 slash_defer_eras: int = 0):
+        if not 0 <= slash_defer_eras < BONDING_DURATION_ERAS:
+            # a deferral >= the bonding duration would let an offender
+            # withdraw the whole ledger before the slash ever applies
+            # (the reference enforces the same: pallet/mod.rs:828)
+            raise ValueError(
+                f"slash_defer_eras {slash_defer_eras} must be < "
+                f"BONDING_DURATION_ERAS {BONDING_DURATION_ERAS}")
         self.state = state
         self.balances = balances
+        self.slash_defer_eras = slash_defer_eras
 
     # -- bonding --------------------------------------------------------------
     def bond(self, who: str, amount: int) -> None:
@@ -102,7 +115,9 @@ class Staking:
             self.state.put(PALLET, "unlocking", who, left)
         else:
             self.state.delete(PALLET, "unlocking", who)
-        self.state.deposit_event(PALLET, "Withdrawn", who=who, amount=due)
+        if due:
+            self.state.deposit_event(PALLET, "Withdrawn", who=who,
+                                     amount=due)
         return due
 
     def unlocking(self, who: str) -> tuple:
@@ -234,9 +249,11 @@ class Staking:
                 for v in active:
                     self.balances.mint(v, v_era * self.bonded(v)
                                        // total_bond)
-        # exposures two eras back can no longer be paid or slashed here
+        # exposures are retained long enough for deferred slashes to
+        # still see the offence era (HistoryDepth analog)
+        retention = max(1, self.slash_defer_eras)
         for (e, v), _ in list(self.state.iter_prefix(PALLET, "exposure")):
-            if e < era_index - 1:
+            if e < era_index - retention:
                 self.state.delete(PALLET, "exposure", e, v)
         self.state.put(PALLET, "era", era_index + 1)
         self.state.deposit_event(PALLET, "EraPaid", era=era_index,
@@ -292,6 +309,40 @@ class Staking:
 
     def slash_fraction(self, who: str, permill: int,
                        era: int | None = None) -> int:
+        if self.slash_defer_eras:
+            # deferred application (SlashDeferDuration): queue now,
+            # apply at era + defer unless governance cancels
+            offence_era = self.current_era() if era is None else era
+            apply_era = self.current_era() + self.slash_defer_eras
+            sid = self.state.get(PALLET, "next_unapplied", default=0)
+            self.state.put(PALLET, "next_unapplied", sid + 1)
+            self.state.put(PALLET, "unapplied", sid,
+                           (who, permill, offence_era, apply_era))
+            self.state.deposit_event(PALLET, "SlashDeferred", id=sid,
+                                     who=who, permill=permill,
+                                     apply_era=apply_era)
+            return 0
+        return self._slash_now(who, permill, era)
+
+    def cancel_deferred_slash(self, sid: int) -> None:
+        """COUNCIL-ONLY (via motion): drop a queued slash before it
+        applies (the reference's governance cancel path)."""
+        if not self.state.contains(PALLET, "unapplied", sid):
+            raise DispatchError("staking.NoSuchSlash", str(sid))
+        self.state.delete(PALLET, "unapplied", sid)
+        self.state.deposit_event(PALLET, "SlashCancelled", id=sid)
+
+    def apply_due_slashes(self) -> None:
+        """Era hook: apply queued slashes whose deferral elapsed."""
+        now = self.current_era()
+        for (sid,), (who, permill, offence_era, apply_era) in sorted(
+                self.state.iter_prefix(PALLET, "unapplied")):
+            if apply_era <= now:
+                self.state.delete(PALLET, "unapplied", sid)
+                self._slash_now(who, permill, offence_era)
+
+    def _slash_now(self, who: str, permill: int,
+                   era: int | None = None) -> int:
         """Slash ``permill``/1000 of the offender's exposure in the
         OFFENCE era (``era``; defaults to the current one) — own stake
         and every exposed nominator (Substrate slashes the offending

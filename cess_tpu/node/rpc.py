@@ -58,6 +58,15 @@ def _encode(obj):
     return obj
 
 
+def _eth_chain_id(spec) -> int:
+    """One derivation for eth_chainId AND net_version (Eth tooling
+    cross-checks them)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.sha256(spec.chain_id.encode()).digest()[:4], "big")
+
+
 def _decode(obj):
     if isinstance(obj, str) and obj.startswith("0x"):
         return bytes.fromhex(obj[2:])
@@ -225,4 +234,29 @@ class RpcServer:
             from .metrics import collect
 
             return collect(node)
+        # -- Eth namespace (Frontier RPC compat surface over the EVM
+        # boundary module; ref node/src/rpc.rs:229-328) ------------------
+        if method == "web3_clientVersion":
+            return "cess-tpu/evm-boundary"
+        if method == "net_version":
+            return str(_eth_chain_id(node.spec))
+        if method == "eth_chainId":
+            return hex(_eth_chain_id(node.spec))
+        if method == "eth_blockNumber":
+            return hex(node.head().number)
+        if method == "eth_getBalance":
+            if not params or not isinstance(params[0], str):
+                raise RpcError(INVALID_PARAMS, "expected [account]")
+            return hex(rt.evm.balance(params[0]))
+        if method == "eth_getCode":
+            if not params:
+                raise RpcError(INVALID_PARAMS, "expected [address]")
+            code = rt.evm.code_at(_decode(params[0]))
+            return "0x" + (code.hex() if code else "")
+        if method == "eth_call":
+            if len(params) < 2:
+                raise RpcError(INVALID_PARAMS,
+                               "expected [address, calldata]")
+            return "0x" + rt.evm.query(_decode(params[0]),
+                                       _decode(params[1])).hex()
         raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")

@@ -207,3 +207,38 @@ def test_same_era_unbonds_merge_and_unbonded_scheduler_still_slashed(rt):
     r0 = rt.balances.reserved("stash9")
     rt.staking.slash_scheduler("stash9")
     assert rt.balances.reserved("stash9") < r0
+
+
+def test_deferred_slash_and_council_cancel():
+    """The reference defers offence slashes 28 eras so governance can
+    cancel wrongful ones (SlashDeferDuration, runtime :563): queued at
+    report time, applied at era + defer, cancellable by council."""
+    rt = Runtime(RuntimeConfig(era_blocks=10, slash_defer_eras=2))
+    rt.system.set_sudo("gov")
+    for w in ("v1", "c1", "c2", "gov"):
+        rt.fund(w, 10_000_000 * D)
+    rt.apply_extrinsic("v1", "staking.bond", 4_000_000 * D)
+    rt.apply_extrinsic("v1", "staking.validate")
+    rt.apply_extrinsic("root", "council.set_members", ("c1", "c2"))
+    rt.advance_blocks(10)
+    b0 = rt.staking.bonded("v1")
+    assert rt.staking.slash_fraction("v1", 100) == 0   # queued, not taken
+    assert rt.staking.bonded("v1") == b0
+    ev = rt.state.events_of("staking", "SlashDeferred")
+    sid = dict(ev[-1].data)["id"]
+    rt.advance_blocks(10)          # 1 era: still deferred
+    assert rt.staking.bonded("v1") == b0
+    rt.advance_blocks(10)          # defer elapsed: applied
+    assert rt.staking.bonded("v1") == b0 * 9 // 10
+    # second offence: queued, then CANCELLED by council before it lands
+    rt.staking.slash_fraction("v1", 100)
+    sid2 = dict(rt.state.events_of("staking",
+                                   "SlashDeferred")[-1].data)["id"]
+    rt.apply_extrinsic("c1", "council.propose",
+                       "staking.cancel_deferred_slash", (sid2,))
+    mid = rt.state.get("council", "next_motion") - 1
+    rt.apply_extrinsic("c2", "council.vote", mid, True)
+    rt.apply_extrinsic("c1", "council.close", mid)
+    b1 = rt.staking.bonded("v1")
+    rt.advance_blocks(30)
+    assert rt.staking.bonded("v1") == b1, "cancelled slash applied"

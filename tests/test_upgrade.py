@@ -159,3 +159,40 @@ def test_metrics_endpoint_and_block_logs(tmp_path):
         assert res["specVersion"] == migrations.SPEC_VERSION
     finally:
         srv.stop()
+
+
+def test_eth_namespace_rpc():
+    """Frontier RPC compat surface over the EVM boundary (ref
+    node/src/rpc.rs:229-328 Eth namespaces)."""
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Network, Node
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "n0", {"alice": spec.session_key("alice")})
+    net = Network([node])
+    net.run_slots(2)
+    node.submit_extrinsic("alice", "evm.deposit", 50 * D)
+    node.submit_extrinsic("alice", "evm.deploy", bytes([0xFE]))
+    net.run_slots(1)
+    addr = [k[0] for k, _ in
+            node.runtime.state.iter_prefix("evm", "code")][0]
+    srv = RpcServer(node, port=0).start()
+    try:
+        def call(method, *params):
+            req = json.dumps({"jsonrpc": "2.0", "id": 1,
+                              "method": method,
+                              "params": list(params)}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}", data=req,
+                    headers={"Content-Type": "application/json"})) as r:
+                return json.load(r)["result"]
+
+        assert call("eth_blockNumber") == hex(3)
+        assert call("eth_chainId").startswith("0x")
+        assert int(call("eth_getBalance", "alice"), 16) == 50 * D
+        assert call("eth_getCode", "0x" + addr.hex()) == "0xfe"
+        assert call("eth_call", "0x" + addr.hex(), "0xabcd") == "0xabcd"
+        assert call("web3_clientVersion").startswith("cess-tpu")
+    finally:
+        srv.stop()
