@@ -353,9 +353,19 @@ class NodeService:
                 return True
             except ValueError as e:
                 if "unknown parent" in str(e):
-                    self._send(conn, (
-                        "sync_request",
-                        max(1, self.node.head().number - SYNC_LOOKBACK)))
+                    if self.node.head().number == 0 \
+                            and self._warp_tries < 3:
+                        # fresh node: checkpoint sync must not race a
+                        # block-by-block replay of the whole chain —
+                        # ask for the snapshot, fall back only after
+                        # the bounded warp attempts fail
+                        self._warp_tries += 1
+                        self._send(conn, ("warp_request", 0))
+                    else:
+                        self._send(conn, (
+                            "sync_request",
+                            max(1, self.node.head().number
+                                - SYNC_LOOKBACK)))
                 return False
 
     def _try_warp(self, snap_bytes: bytes, just) -> bool:
