@@ -133,6 +133,7 @@ class NodeService:
         self.max_peers = 64   # discovery cap: bounds dial threads
         self.errors: list[str] = []      # swallowed faults, for tests/ops
         self._warp_tries = 0
+        self._warp_backoff = 0.0
         self._listener: socket.socket | None = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -297,16 +298,23 @@ class NodeService:
                 self._discover(payload)
         elif kind == "status":
             peer_head, _, peer_fin = payload
+            now = time.time()
             with self.lock:
                 ours = self.node.head().number
-            if ours == 0 and peer_fin > WARP_THRESHOLD \
-                    and self._warp_tries < 3:
+                warp_viable = (ours == 0 and peer_fin > WARP_THRESHOLD
+                               and self._warp_tries < 3)
+                fire_warp = warp_viable and now >= self._warp_backoff
+                if fire_warp:
+                    # one attempt per backoff window, not per status
+                    # tick — a large snapshot takes time to arrive
+                    self._warp_tries += 1
+                    self._warp_backoff = now + 1.0
+            if fire_warp:
                 # fresh node far behind a finalized peer: checkpoint
-                # sync instead of replaying the whole chain; after a
-                # few failed attempts fall back to full replay sync
-                self._warp_tries += 1
+                # sync instead of replaying the whole chain; bounded
+                # attempts then fall back to full replay sync
                 self._send(conn, ("warp_request", 0))
-            elif peer_head > ours:
+            elif peer_head > ours and not warp_viable:
                 self._send(conn, ("sync_request",
                                   max(1, ours - SYNC_LOOKBACK)))
         elif kind == "warp_request":
@@ -355,12 +363,12 @@ class NodeService:
                 if "unknown parent" in str(e):
                     if self.node.head().number == 0 \
                             and self._warp_tries < 3:
-                        # fresh node: checkpoint sync must not race a
-                        # block-by-block replay of the whole chain —
-                        # ask for the snapshot, fall back only after
-                        # the bounded warp attempts fail
-                        self._warp_tries += 1
-                        self._send(conn, ("warp_request", 0))
+                        # fresh node with warp still plausible: stay
+                        # quiet — the status exchange (every slot)
+                        # drives checkpoint-vs-replay policy in ONE
+                        # place; requesting a replay here would race
+                        # the in-flight snapshot adoption
+                        pass
                     else:
                         self._send(conn, (
                             "sync_request",
