@@ -54,33 +54,82 @@ class FinalityGadget:
         self._tally: dict[int, dict[bytes, dict[str, Vote]]] = {}
         # round -> voter -> first-seen Vote (for equivocation detection)
         self._first: dict[int, dict[str, Vote]] = {}
+        # (round, voter) pairs handed out by vote_jobs but not yet
+        # ingested — prevents a concurrent collector from double-
+        # signing the same round (self-equivocation)
+        self._signing: set[tuple[int, str]] = set()
         self.equivocations: list[tuple[Vote, Vote]] = []
         self.justifications: dict[int, Justification] = {}
 
     # -- outgoing ----------------------------------------------------------
-    def cast_votes(self) -> list[Vote]:
-        """Votes from every local authority key for the current HEAD
-        (round = head height; a justification finalizes the target and
-        every ancestor). Voting only the head keeps liveness across
-        reorgs: a voter that committed to a dead branch at height h
-        can never re-vote round h (that would be equivocation), but
-        the chain outgrows h and a fresh round finalizes past it."""
+    # sync batches can advance the head many blocks at once; voting
+    # only the head round would skip the intermediate rounds entirely
+    # and starve them of this voter forever (each voter votes a round
+    # at most once). Vote a bounded tail of rounds instead.
+    VOTE_TAIL = 32
+
+    def vote_jobs(self) -> list[tuple]:
+        """Collect the (account, key, round, target_hash) tuples this
+        node should sign: every unvoted round up to the current HEAD
+        (round = block height; the round target is the canonical block
+        at that height). Voting the whole unfinalized tail keeps
+        liveness for straggler nodes whose head jumps in sync batches,
+        and across reorgs: a voter that committed to a dead branch at
+        height h can never re-vote round h (that would be
+        equivocation), but the chain outgrows h and a fresh round
+        finalizes past it.
+
+        Caller holds the node lock. Collected rounds are marked
+        in-flight so a concurrent collector cannot double-sign them
+        (self-equivocation); ingest_own clears the marks."""
         node = self.node
-        out = []
+        jobs = []
         head = node.chain[-1]
-        rnd = head.number
-        if rnd <= node.finalized:
-            return out
-        for account, key in node.keystore.items():
-            if account not in node.authorities:
+        if head.number <= node.finalized:
+            return jobs
+        lo = max(node.finalized + 1, head.number - self.VOTE_TAIL + 1)
+        for rnd in range(lo, head.number + 1):
+            target = node.chain[rnd]
+            for account, key in node.keystore.items():
+                if account not in node.authorities:
+                    continue
+                if account in self._first.get(rnd, {}) \
+                        or (rnd, account) in self._signing:
+                    continue   # never double-vote (that's equivocation)
+                self._signing.add((rnd, account))
+                jobs.append((account, key, rnd, target.hash()))
+        return jobs
+
+    def sign_jobs(self, jobs: list[tuple]) -> list[Vote]:
+        """ed25519-sign collected jobs — ~6 ms each in pure python, so
+        callers run this OUTSIDE the node lock (the TCP service would
+        otherwise stall recv/RPC/authoring for a whole sync batch)."""
+        gh = self.node.runtime.genesis_hash()
+        return [sign_vote(key, gh, account, rnd, th, rnd)
+                for (account, key, rnd, th) in jobs]
+
+    def ingest_own(self, votes: list[Vote]) -> None:
+        """Tally self-signed votes (caller holds the lock). Signature
+        verification is skipped — we just produced them."""
+        node = self.node
+        for v in votes:
+            self._signing.discard((v.round, v.voter))
+            if v.round <= node.finalized:
                 continue
-            if account in self._first.get(rnd, {}):
-                continue   # never double-vote (that's equivocation)
-            v = sign_vote(key, node.runtime.genesis_hash(), account,
-                          rnd, head.hash(), rnd)
-            self.on_vote(v)   # count own vote
-            out.append(v)
-        return out
+            first = self._first.setdefault(v.round, {})
+            if v.voter in first:
+                continue
+            first[v.voter] = v
+            self._tally.setdefault(v.round, {}).setdefault(
+                v.target_hash, {})[v.voter] = v
+            self._try_finalize(v.round, v.target_hash)
+
+    def cast_votes(self) -> list[Vote]:
+        """Single-threaded convenience (the in-process Network driver):
+        collect + sign + tally in one call."""
+        votes = self.sign_jobs(self.vote_jobs())
+        self.ingest_own(votes)
+        return votes
 
     # -- incoming ----------------------------------------------------------
     def on_vote(self, vote: Vote) -> None:

@@ -4,11 +4,19 @@ The reference's node talks libp2p — block announcement, tx
 propagation, GRANDPA vote gossip, and catch-up sync between processes
 (/root/reference/node/src/service.rs:259-274,508-537). This module is
 the framework-native equivalent over plain TCP: length-prefixed
-canonical-codec frames carrying (msg_type, payload) tuples, full-mesh
-peering, flood gossip with seen-set dedup, and a walk-back sync
-request for missed blocks. The in-process ``Network`` driver and this
-transport run the SAME ``Node``: consensus, fork choice and finality
-live in the node; this layer only moves bytes.
+canonical-codec frames carrying (msg_type, payload) tuples,
+bounded-degree peering, flood gossip with a generational seen-set, and
+a walk-back sync request for missed blocks. The in-process ``Network``
+driver and this transport run the SAME ``Node``: consensus, fork
+choice and finality live in the node; this layer only moves bytes.
+
+Topology is degree-limited (the libp2p role, service.rs:259-274):
+each node dials its ``degree//2`` ring successors in sorted port
+order (deterministic, so the union graph is a connected ring with
+chords), accepts at most ``degree`` inbound connections, and every
+connection owns a bounded outbound queue drained by a dedicated
+sender thread — a stalled peer socket fills its queue and gets
+dropped; it can never wedge the node lock shared with authoring/RPC.
 
 Fault injection (``FaultPolicy``) drops or reorders outbound messages
 deterministically — the gossip layer must converge anyway via sync
@@ -24,17 +32,23 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
   ("just", Justification)         finality proof propagation
   ("warp_request", 0)              checkpoint-sync ask (fresh nodes)
   ("warp_response", (snapshot_payload_bytes, Justification))
-                                   snapshot + finality countersignatures
-                                   (verified by Node.warp_sync logic)
+                                   snapshot + finality countersignatures,
+                                   verified against the GENESIS-derived
+                                   authority set (never the snapshot's
+                                   own), and only accepted while a
+                                   warp_request is outstanding on the
+                                   same connection
   ("peers", (port, ...))           peer exchange (discovery): each side
-                                   shares its known listen ports; unknown
-                                   ones get dialed — the reference's
+                                   shares its known listen ports; the
+                                   ring-successor rule picks which of
+                                   them get dialed — the reference's
                                    Kademlia authority-discovery role
                                    (service.rs:508-537), flood-simple
 """
 from __future__ import annotations
 
 import dataclasses
+import queue
 import socket
 import struct
 import threading
@@ -48,6 +62,10 @@ MAX_FRAME = 64 * 1024 * 1024
 SYNC_BATCH = 64
 SYNC_LOOKBACK = 8   # re-request a short tail to cover small forks
 WARP_THRESHOLD = 50  # finalized blocks behind which a fresh node warps
+SEEN_CAP = 8192      # generational dedup-set rotation threshold
+ERRORS_CAP = 256
+SEND_QUEUE_CAP = 256    # outbound frames buffered per connection
+SEND_TIMEOUT = 5.0      # stalled-socket kill switch (seconds)
 
 
 @dataclasses.dataclass
@@ -67,17 +85,57 @@ class FaultPolicy:
 
 
 class _Conn:
-    def __init__(self, sock: socket.socket):
+    """One TCP connection with a bounded outbound queue drained by its
+    own sender thread. ``send`` never blocks the caller: a full queue
+    (stalled peer) drops the frame; a send stalled past SEND_TIMEOUT
+    kills the connection."""
+
+    def __init__(self, sock: socket.socket, inbound: bool = False):
         self.sock = sock
-        self.send_lock = threading.Lock()
         self.alive = True
+        self.inbound = inbound
+        self.warp_requested = False   # gate for warp_response acceptance
+        self.dropped = 0
+        self.rx = 0                   # frames received (dial liveness)
+        self._q: queue.Queue[bytes | None] = queue.Queue(SEND_QUEUE_CAP)
+        self._sender = threading.Thread(target=self._drain, daemon=True)
+        self._sender.start()
 
     def send(self, raw: bytes) -> None:
-        with self.send_lock:
-            self.sock.sendall(_LEN.pack(len(raw)) + raw)
+        if not self.alive:
+            return
+        try:
+            self._q.put_nowait(_LEN.pack(len(raw)) + raw)
+        except queue.Full:
+            self.dropped += 1   # overflow drop: slow peer loses frames
+
+    def _drain(self) -> None:
+        # send-ONLY stall timeout: settimeout() would poison the recv
+        # side of the shared socket (recv must block indefinitely on an
+        # idle link), so arm SO_SNDTIMEO for the kernel send path alone
+        try:
+            self.sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", int(SEND_TIMEOUT),
+                            int(SEND_TIMEOUT % 1 * 1_000_000)))
+        except OSError:
+            pass   # platform without SO_SNDTIMEO: bounded queue still caps
+        while True:
+            frame = self._q.get()
+            if frame is None or not self.alive:
+                return
+            try:
+                self.sock.sendall(frame)
+            except (OSError, ValueError):
+                self.close()
+                return
 
     def close(self) -> None:
         self.alive = False
+        try:
+            self._q.put_nowait(None)   # unblock the sender thread
+        except queue.Full:
+            pass
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -111,7 +169,8 @@ class NodeService:
     def __init__(self, node, port: int, peers: list[int],
                  host: str = "127.0.0.1", slot_time: float = 0.2,
                  genesis_time: float = 0.0,
-                 faults: FaultPolicy | None = None):
+                 faults: FaultPolicy | None = None,
+                 degree: int = 8):
         self.node = node
         # all processes must agree on slot numbering (slot is signed
         # into VRF claims and drives epoch derivation): slots count
@@ -122,16 +181,28 @@ class NodeService:
         self.peer_ports = peers
         self.slot_time = slot_time
         self.faults = faults
+        self.degree = max(2, degree)
         self.lock = threading.RLock()
         self.conns: list[_Conn] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
-        self._seen: set[bytes] = set()   # gossip dedup (frame hashes)
+        # gossip dedup: generational pair of sets — membership checks
+        # both, inserts go to the young set, rotation at SEEN_CAP keeps
+        # memory bounded on a long-running node
+        self._seen: set[bytes] = set()
+        self._seen_old: set[bytes] = set()
         # peer-exchange state lives here (NOT start()): inbound frames
         # can arrive before start() finishes its own assignments
         self._known_peers: set[int] = set(peers)
-        self.max_peers = 64   # discovery cap: bounds dial threads
+        self._dialing: set[int] = set()
+        # dead-peer cooling: a port that keeps failing is excluded from
+        # ring-successor selection until its retry time, so the ring
+        # SLIDES past crashed nodes instead of letting dead runs
+        # partition the gossip graph (full-mesh robustness, kept)
+        self._cooling: dict[int, float] = {}
+        self.max_peers = 64   # discovery cap: bounds the learned set
         self.errors: list[str] = []      # swallowed faults, for tests/ops
+        self.msgs_sent = 0               # transport telemetry (tests)
         self._warp_tries = 0
         self._warp_backoff = 0.0
         self._listener: socket.socket | None = None
@@ -144,15 +215,44 @@ class NodeService:
         srv.listen(16)
         self._listener = srv
         self._spawn(self._accept_loop, srv)
-        for p in self.peer_ports:
-            self._spawn(self._dial_loop, p)
+        self._redial()
         self._spawn(self._author_loop)
 
+    def _dial_targets(self) -> list[int]:
+        """Ring-successor selection: the ``degree//2`` known LIVE ports
+        that cyclically follow our own in sorted order (ports in their
+        cooling window after repeated failures are skipped, so the
+        ring advances past dead nodes). Every node dialing its
+        successors yields a connected ring with chords at bounded
+        per-node degree (out = degree//2, in <= degree//2 + slack
+        under the same rule) — the structured-discovery stand-in for
+        the reference's Kademlia DHT (service.rs:508-537)."""
+        now = time.time()
+        with self.lock:
+            for p, until in list(self._cooling.items()):
+                if now >= until:
+                    del self._cooling[p]
+            known = sorted(p for p in self._known_peers
+                           if p != self.port and p not in self._cooling)
+        if not known:
+            return []
+        d = max(1, self.degree // 2)
+        after = [p for p in known if p > self.port]
+        ring = after + [p for p in known if p < self.port]
+        return ring[:d]
+
+    def _redial(self) -> None:
+        for p in self._dial_targets():
+            with self.lock:
+                if p in self._dialing:
+                    continue
+                self._dialing.add(p)
+            self._spawn(self._dial_loop, p)
+
     def _discover(self, ports) -> None:
-        """Peer exchange: dial newly learned listen ports. Bounded by
-        max_peers — an unauthenticated frame must not be able to spawn
-        unbounded dial threads. Membership check+add runs under the
-        service lock (concurrent recv threads must not double-dial)."""
+        """Peer exchange: learn listen ports, then let the ring rule
+        decide which to dial. Bounded by max_peers — an
+        unauthenticated frame must not grow state without limit."""
         for p in ports:
             if not (isinstance(p, int) and not isinstance(p, bool)
                     and 0 < p < 65536 and p != self.port):
@@ -162,7 +262,7 @@ class NodeService:
                         or p in self._known_peers:
                     continue
                 self._known_peers.add(p)
-            self._spawn(self._dial_loop, p)
+        self._redial()
 
     def stop(self) -> None:
         self._stop.set()
@@ -181,6 +281,10 @@ class NodeService:
         t.start()
         self._threads.append(t)
 
+    def _record_error(self, msg: str) -> None:
+        self.errors.append(msg)
+        del self.errors[:-ERRORS_CAP]
+
     # -- connections --------------------------------------------------------
     def _accept_loop(self, srv: socket.socket) -> None:
         while not self._stop.is_set():
@@ -188,28 +292,64 @@ class NodeService:
                 sock, _ = srv.accept()
             except OSError:
                 return
-            conn = _Conn(sock)
+            alive = [c for c in self.conns if c.alive]
+            in_alive = sum(1 for c in alive if c.inbound)
+            # inbound cap with ONE slack slot over the steady-state
+            # in-degree (degree//2): a late joiner not yet in anyone's
+            # ring must be able to land its first connection and get
+            # its port gossiped — a hard cap at `degree` would lock
+            # it out forever once the ring saturates. Total live
+            # connections are therefore bounded by degree + 1.
+            if in_alive > self.degree // 2 \
+                    or len(alive) >= self.degree + 1:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            conn = _Conn(sock, inbound=True)
             self.conns.append(conn)
             self._spawn(self._recv_loop, conn)
 
+    DIAL_FAILS_MAX = 20     # consecutive failures before cooling
+    COOL_SECONDS = 5.0      # how long a dead port sits out of the ring
+
     def _dial_loop(self, port: int) -> None:
-        """Keep one outbound connection to a peer alive (retry)."""
+        """Keep one outbound connection to a ring peer alive (retry
+        while it remains a ring target). A port that keeps failing —
+        connect refused, or connections that die before delivering a
+        single frame (e.g. a peer refusing us at its inbound cap) —
+        goes into cooling and the ring re-targets around it."""
+        fails = 0
         while not self._stop.is_set():
+            if port not in self._dial_targets():
+                with self.lock:
+                    self._dialing.discard(port)
+                return   # ring moved (new peers learned): stop dialing
+            if fails >= self.DIAL_FAILS_MAX:
+                with self.lock:
+                    self._cooling[port] = time.time() + self.COOL_SECONDS
+                    self._dialing.discard(port)
+                self._redial()   # pick the next live successor
+                return
             try:
                 sock = socket.create_connection((self.host, port),
                                                 timeout=2.0)
                 sock.settimeout(None)
             except OSError:
+                fails += 1
                 time.sleep(0.05)
                 continue
             conn = _Conn(sock)
             self.conns.append(conn)
             self._send_status(conn)
-            self._send(conn, ("peers",
-                              (self.port, *sorted(self._known_peers))))
+            with self.lock:
+                known = (self.port, *sorted(self._known_peers))
+            self._send(conn, ("peers", known))
             self._recv_loop(conn)   # blocks until closed
             if conn in self.conns:
                 self.conns.remove(conn)
+            fails = 0 if conn.rx else fails + 1
             time.sleep(0.05)
 
     def _recv_loop(self, conn: _Conn) -> None:
@@ -220,6 +360,7 @@ class NodeService:
                 break
             if raw is None:
                 break
+            conn.rx += 1
             try:
                 msg = codec.decode(raw)
                 self._handle(msg, conn)
@@ -229,30 +370,37 @@ class NodeService:
                 # kill the service
                 continue
         conn.close()
+        if conn in self.conns:
+            self.conns.remove(conn)
 
     # -- sending ------------------------------------------------------------
     def _send(self, conn: _Conn, msg) -> None:
         if self.faults is not None and not self.faults.allow():
             return
-        try:
-            conn.send(codec.encode(msg))
-        except OSError:
-            conn.close()
+        self.msgs_sent += 1
+        conn.send(codec.encode(msg))
+
+    def _mark_seen(self, digest: bytes) -> None:
+        self._seen.add(digest)
+        if len(self._seen) >= SEEN_CAP:
+            self._seen_old = self._seen
+            self._seen = set()
+
+    def _was_seen(self, digest: bytes) -> bool:
+        return digest in self._seen or digest in self._seen_old
 
     def broadcast(self, msg, mark_seen: bool = True) -> None:
         raw = codec.encode(msg)
         if mark_seen:
             import hashlib
 
-            self._seen.add(hashlib.sha256(raw).digest())
+            self._mark_seen(hashlib.sha256(raw).digest())
         for conn in list(self.conns):
             if conn.alive:
                 if self.faults is not None and not self.faults.allow():
                     continue
-                try:
-                    conn.send(raw)
-                except OSError:
-                    conn.close()
+                self.msgs_sent += 1
+                conn.send(raw)
 
     def _send_status(self, conn: _Conn) -> None:
         with self.lock:
@@ -268,9 +416,9 @@ class NodeService:
         kind, payload = msg
         raw_hash = hashlib.sha256(codec.encode(msg)).digest()
         if kind in ("tx", "block", "vote", "just"):
-            if raw_hash in self._seen:
+            if self._was_seen(raw_hash):
                 return
-            self._seen.add(raw_hash)
+            self._mark_seen(raw_hash)
         if kind == "tx":
             with self.lock:
                 try:
@@ -313,6 +461,7 @@ class NodeService:
                 # fresh node far behind a finalized peer: checkpoint
                 # sync instead of replaying the whole chain; bounded
                 # attempts then fall back to full replay sync
+                conn.warp_requested = True
                 self._send(conn, ("warp_request", 0))
             elif peer_head > ours and not warp_viable:
                 self._send(conn, ("sync_request",
@@ -331,6 +480,9 @@ class NodeService:
             snap_bytes, just = payload
             from .finality import Justification
 
+            if not conn.warp_requested:
+                return   # unsolicited snapshot push: refuse
+            conn.warp_requested = False
             if not isinstance(snap_bytes, bytes) \
                     or not isinstance(just, Justification):
                 return
@@ -355,6 +507,7 @@ class NodeService:
                 self._after_chain_move()
 
     def _import(self, block, conn: _Conn) -> bool:
+        want_sync_from = None
         with self.lock:
             try:
                 self.node.import_block(block)
@@ -370,47 +523,39 @@ class NodeService:
                         # the in-flight snapshot adoption
                         pass
                     else:
-                        self._send(conn, (
-                            "sync_request",
-                            max(1, self.node.head().number
-                                - SYNC_LOOKBACK)))
-                return False
+                        want_sync_from = max(
+                            1, self.node.head().number - SYNC_LOOKBACK)
+                ok = False
+        # send OUTSIDE the node lock: a stalled peer must not hold it
+        if want_sync_from is not None:
+            self._send(conn, ("sync_request", want_sync_from))
+        return ok
 
     def _try_warp(self, snap_bytes: bytes, just) -> bool:
-        """Verify + adopt a checkpoint (caller holds the lock): same
-        trust model as Node.warp_sync_from, over the wire."""
+        """Verify + adopt a checkpoint (caller holds the lock): the ONE
+        shared trust path, store.verify_and_adopt_warp — justification
+        verified against OUR genesis-derived authority set (never the
+        snapshot's own), genesis-anchored header chain, state-root-
+        proven KV. Fails closed (-> full replay sync) if the authority
+        set has rotated since genesis."""
         from . import store as _store
         from .network import Node as _Node
 
         node = self.node
-        if node.head().number != 0:
-            return False
-        probe = _Node(node.spec, f"{node.name}-warp", {})
-        if not _store.restore_snapshot_payload(probe, snap_bytes):
-            return False
-        chain = probe.chain
-        if chain[0].hash() != node.chain[0].hash():
-            return False
-        for parent, child in zip(chain, chain[1:]):
-            if child.parent != parent.hash()                     or child.number != parent.number + 1:
-                return False
-        if not (0 < just.target_number < len(chain)
-                and chain[just.target_number].hash() == just.target_hash):
-            return False
-        if not probe.finality.verify_justification(just):
-            return False
-        if not _store.restore_snapshot_payload(node, snap_bytes):
-            return False
-        node.finality.justifications[just.round] = just
-        node.finalized = max(node.finalized, just.target_number)
-        if node.store is not None:
-            _store.write_snapshot(node.base_path, node)
-        return True
+        return _store.verify_and_adopt_warp(
+            node, snap_bytes, just,
+            lambda: _Node(node.spec, f"{node.name}-warp", {}))
 
     def _after_chain_move(self) -> None:
-        """Cast + gossip finality votes and any new justification."""
+        """Cast + gossip finality votes and any new justification.
+        Signing happens OUTSIDE the node lock (up to VOTE_TAIL slow
+        pure-python signatures after a sync batch must not stall
+        recv/RPC/authoring)."""
         with self.lock:
-            votes = self.node.finality.cast_votes()
+            jobs = self.node.finality.vote_jobs()
+        votes = self.node.finality.sign_jobs(jobs)
+        with self.lock:
+            self.node.finality.ingest_own(votes)
             fin = self.node.finalized
             just = self.node.finality.justifications.get(fin)
         for v in votes:
@@ -442,7 +587,7 @@ class NodeService:
                     if blk is not None:
                         self.node.commit_proposal()
                 except Exception as e:   # noqa: BLE001 — author loop must survive
-                    self.errors.append(f"author slot {slot}: {e!r}")
+                    self._record_error(f"author slot {slot}: {e!r}")
                     if self.node._proposal is not None:
                         self.node.abort_proposal()
                     blk = None
@@ -456,6 +601,9 @@ class NodeService:
             for conn in list(self.conns):
                 if conn.alive:
                     self._send_status(conn)
+            # periodic re-dial sweep: expired coolings rejoin the ring,
+            # ring changes from discovery get their dial loops
+            self._redial()
 
     # -- client surface ------------------------------------------------------
     def submit(self, xt) -> None:

@@ -178,6 +178,8 @@ class Node:
         block, imports the peer's tail (fork choice decides whether to
         adopt), then verifies + adopts the peer's justifications.
         Returns the number of blocks imported."""
+        if self.chain[0].hash() != peer.chain[0].hash():
+            return 0   # different genesis: not our chain, refuse cleanly
         common = min(self.head().number, peer.head().number)
         while self.chain[common].hash() != peer.chain[common].hash():
             common -= 1
@@ -207,53 +209,20 @@ class Node:
         WITHOUT replaying the chain — the reference's warp-sync role
         (service.rs:259-263), shaped like production checkpoint sync.
 
-        Trust model (verified before adoption, in this order):
-        1. the snapshot's header chain starts at OUR locally-computed
-           genesis (same spec => same genesis hash) and is parent-
-           linked with consecutive numbers throughout;
-        2. the snapshot KV re-derives the head header's state root
-           (restore_snapshot_payload enforces this);
-        3. the peer's newest justification targets a block ON that
-           chain and carries >= 2/3 valid signatures from the
-           authority set + session keys recorded IN the adopted state.
-        Skipped (the warp trade-off, same as the reference's): per-
-        block claim verification and execution. A fabricated snapshot
-        must therefore forge 2/3 of finality signatures to be adopted.
-        Only meaningful on a fresh node (no local progress). The TCP
-        transport runs the same checks over the wire
-        (net.NodeService._try_warp)."""
+        Trust model: store.verify_and_adopt_warp — the ONE shared
+        verification path (genesis-derived authority set, never the
+        snapshot's own; genesis-anchored parent-linked header chain;
+        state-root-proven KV; justification targeting that chain),
+        also used by the TCP transport (net.NodeService._try_warp)."""
         from . import store as _store
 
-        if self.head().number != 0:
-            return False
         if not peer.finality.justifications:
             return False
-        payload = _store.snapshot_payload(peer)
-        snap_node = Node(self.spec, f"{self.name}-warp", {})
-        if not _store.restore_snapshot_payload(snap_node, payload):
-            return False
-        chain = snap_node.chain
-        if chain[0].hash() != self.chain[0].hash():
-            return False   # different genesis: not our chain
-        for parent, child in zip(chain, chain[1:]):
-            if child.parent != parent.hash() \
-                    or child.number != parent.number + 1:
-                return False
         rnd = max(peer.finality.justifications)
         just = peer.finality.justifications[rnd]
-        if not (0 < just.target_number < len(chain)
-                and chain[just.target_number].hash() == just.target_hash):
-            return False
-        if not snap_node.finality.verify_justification(just):
-            return False
-        # adopt wholesale (state root already proven against the head)
-        if not _store.restore_snapshot_payload(self, payload):
-            return False
-        self.finality.justifications[rnd] = just
-        self.finalized = max(self.finalized, just.target_number)
-        if self.store is not None:
-            _store.write_snapshot(self.base_path, self)
-        return True
+        return _store.verify_and_adopt_warp(
+            self, _store.snapshot_payload(peer), just,
+            lambda: Node(self.spec, f"{self.name}-warp", {}))
 
     # -- tx pool ---------------------------------------------------------------
     def queue_heartbeats(self) -> list[SignedExtrinsic]:

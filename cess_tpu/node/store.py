@@ -135,6 +135,58 @@ def load_snapshot(base_path: str, node) -> bool:
     return restore_snapshot_payload(node, raw[len(_MAGIC):])
 
 
+def verify_and_adopt_warp(node, snap_bytes: bytes, just,
+                          make_probe) -> bool:
+    """The ONE warp-sync trust path, shared by the in-process
+    Node.warp_sync_from and the TCP NodeService._try_warp.
+
+    Verified before adoption, in this order:
+    1. the justification carries >= 2/3 valid signatures from the
+       authority set + session keys of the node's OWN (genesis) state —
+       the trusted base derived from the chain spec, NEVER material
+       carried by the snapshot being judged (else any attacker snapshot
+       naming its own authorities would self-verify). If the set has
+       legitimately rotated since genesis this fails closed and the
+       caller falls back to full replay sync (the reference instead
+       follows authority-set handoff proofs from genesis);
+    2. the snapshot's header chain starts at the node's locally
+       computed genesis and is parent-linked with consecutive numbers;
+    3. the snapshot KV re-derives the head's state root
+       (restore_snapshot_payload enforces this) and the justification
+       targets a block ON that chain.
+    Skipped (the warp trade-off, same as the reference's): per-block
+    claim verification and execution. Only meaningful on a fresh node.
+
+    ``make_probe()`` builds a throwaway same-spec node used to decode
+    the snapshot without touching ``node`` until every check passes.
+    """
+    if node.head().number != 0:
+        return False
+    if not (0 < just.target_number
+            and node.finality.verify_justification(just)):
+        return False
+    probe = make_probe()
+    if not restore_snapshot_payload(probe, snap_bytes):
+        return False
+    chain = probe.chain
+    if chain[0].hash() != node.chain[0].hash():
+        return False
+    for parent, child in zip(chain, chain[1:]):
+        if child.parent != parent.hash() \
+                or child.number != parent.number + 1:
+            return False
+    if not (just.target_number < len(chain)
+            and chain[just.target_number].hash() == just.target_hash):
+        return False
+    if not restore_snapshot_payload(node, snap_bytes):
+        return False
+    node.finality.justifications[just.round] = just
+    node.finalized = max(node.finalized, just.target_number)
+    if node.store is not None:
+        write_snapshot(node.base_path, node)
+    return True
+
+
 def restore_snapshot_payload(node, payload: bytes) -> bool:
     """Decode + integrity-check a checkpoint payload into ``node``."""
     try:
@@ -142,6 +194,11 @@ def restore_snapshot_payload(node, payload: bytes) -> bool:
          finalized, justifications,
          genesis_slot) = codec.decode(payload)
     except (codec.CodecError, ValueError):
+        return False
+    if not chain or chain[0].hash() != node.chain[0].hash():
+        # empty chain (head() would explode later) or a different
+        # genesis than our spec-derived one: refuse before touching
+        # any node state
         return False
     state = node.runtime.state
     prev_kv, prev_block = state.kv, state.block
@@ -159,20 +216,34 @@ def restore_snapshot_payload(node, payload: bytes) -> bool:
     node.chain = list(chain)
     # rebuild the block-tree index for the canonical chain (bodies are
     # re-registered when the block-log replay re-imports them); no undo
-    # logs survive a restart, so snapshot blocks cannot be rewound
+    # logs survive a restart, so snapshot blocks cannot be rewound.
+    # Pre-restore tree state (headers/bodies/authsets from any chain
+    # built before this restore) must not survive — stale entries
+    # would mix two histories.
     node.headers = {}
+    node.bodies = {}
+    node.block_bodies = {}
     node._primaries = {}
     node._undo = {}
+    node._authset = {}
     prev_primaries = 0
     for hd in node.chain:
         h = hd.hash()
         node.headers[h] = hd
         prev_primaries += 1 if (hd.claim and hd.claim.vrf) else 0
         node._primaries[h] = prev_primaries
-        # checkpoint approximation: historical per-block authority
-        # sets are not in the snapshot; stamp the restored set (exact
-        # for the head, which is what finality verification targets)
-        node._authset[h] = tuple(authorities)
+    # Historical per-block authority sets are not in the snapshot.
+    # Stamp genesis with the spec-derived set and the head (+ its
+    # parent, which is what a head-targeting justification verifies
+    # against) with the restored set; justification verification for
+    # intermediate heights falls back to the genesis set — i.e. only
+    # checkpoint-head justifications are verified against the exact
+    # set; deeper history is conservative (fails closed on rotation).
+    node._authset[node.chain[0].hash()] = tuple(
+        v.account for v in node.spec.validators)
+    node._authset[node.chain[-1].hash()] = tuple(authorities)
+    if len(node.chain) > 1:
+        node._authset[node.chain[-2].hash()] = tuple(authorities)
     node.rrsc.randomness = {int(k): v for k, v in randomness.items()}
     node.rrsc._epoch_vrf = {int(k): list(v) for k, v in epoch_vrf.items()}
     node.authorities = tuple(authorities)
