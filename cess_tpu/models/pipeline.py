@@ -72,7 +72,7 @@ class StoragePipeline:
 
     def tag_step(self, fragments: jnp.ndarray,
                  fragment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
-        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks].
+        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks, 2].
 
         fragment_ids: unique-per-key ids ([B, k+m] or [B, k+m, 2] hash
         word pairs, see podr2.fragment_id_from_hash). The arange default
@@ -88,7 +88,7 @@ class StoragePipeline:
             fragment_ids = fragment_ids.reshape(
                 (b * rows, 2) if fragment_ids.ndim == 3 else (b * rows,))
         tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
-        return tags.reshape(b, rows, -1)
+        return tags.reshape(b, rows, *tags.shape[1:])
 
     def forward(self, segments: jnp.ndarray,
                 fragment_ids: jnp.ndarray | None = None) -> dict[str, jnp.ndarray]:

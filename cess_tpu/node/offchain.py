@@ -309,8 +309,8 @@ class Proof:
     chain sees only the codec-encoded bytes and caps the REAL wire
     size at SIGMA_MAX (runtime/src/lib.rs:992) — ~1.06 KiB here,
     constant in the number of fragments."""
-    mu: np.ndarray      # [sectors] uint32
-    sigma: int          # field element
+    mu: np.ndarray              # [sectors] uint32
+    sigma: tuple[int, int]      # F_p^2 element (two base-field limbs)
 
 
 def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
@@ -321,7 +321,7 @@ def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
     held = [h for h in owed if h in store]
     if not held:
         return codec.encode(Proof(
-            mu=np.zeros((podr2.SECTORS,), np.uint32), sigma=0))
+            mu=np.zeros((podr2.SECTORS,), np.uint32), sigma=(0, 0)))
     frags = np.stack([np.frombuffer(store[h], dtype=np.uint8)
                       for h in held])
     tag_arr = np.stack([tags[h] for h in held])
@@ -331,7 +331,9 @@ def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
     r = podr2.aggregate_coeffs(seed, ids)
     mu, sigma = podr2.prove_aggregate(jnp.asarray(frags),
                                       jnp.asarray(tag_arr), idx, nu, r)
-    return codec.encode(Proof(mu=np.asarray(mu), sigma=int(sigma)))
+    sigma = np.asarray(sigma)
+    return codec.encode(Proof(mu=np.asarray(mu),
+                              sigma=(int(sigma[0]), int(sigma[1]))))
 
 
 class TeeAgent:
@@ -439,17 +441,19 @@ class TeeAgent:
         if not (isinstance(proof, Proof) and isinstance(proof.mu, np.ndarray)
                 and proof.mu.shape == (podr2.SECTORS,)
                 and proof.mu.dtype == np.uint32
-                and isinstance(proof.sigma, int)
-                and 0 <= proof.sigma < pf.P):
+                and isinstance(proof.sigma, tuple)
+                and len(proof.sigma) == podr2.LIMBS
+                and all(isinstance(s, int) and 0 <= s < pf.P
+                        for s in proof.sigma)):
             return False
         if not owed:
-            return proof.sigma == 0 and not proof.mu.any()
+            return proof.sigma == (0, 0) and not proof.mu.any()
         ids = np.stack([podr2.fragment_id_from_hash(h) for h in owed])
         r = podr2.aggregate_coeffs(seed, ids)
         ok = podr2.verify_aggregate(self.key, jnp.asarray(ids), self.blocks,
                                     idx, nu, r,
                                     jnp.asarray(proof.mu),
-                                    jnp.uint32(proof.sigma))
+                                    jnp.asarray(proof.sigma, dtype=jnp.uint32))
         return bool(np.asarray(ok))
 
 

@@ -9,24 +9,39 @@ CESS's external TEE repos; on-chain only the contract shows: proof blob
 <= SIGMA_MAX = 2048 bytes (runtime/src/lib.rs:992), challenge = chunk
 indices + 20-byte randoms.
 
-Here the scheme is a Shacham-Waters private-verification PoR over
-F_p (p = 2^31 - 1), redesigned for batched TPU execution:
+Here the scheme is a Shacham-Waters private-verification PoR with the
+MAC over F_p^2, p = 2^31 - 1 (data stays in F_p), redesigned for
+batched TPU execution:
 
 - A fragment (FRAGMENT_SIZE bytes) is split into ``blocks`` of
   ``sectors`` field elements (2 bytes each, so power-of-two fragment
   sizes divide into whole 512-byte blocks). For 8 MiB fragments and
   sectors=256: 16384 blocks.
-- TagGen (TEE secret key (alpha[sectors], prf_key)):
-      tag[b] = f_k(fragment_id, b) + sum_j alpha[j] * m[b, j]   (mod p)
-- Challenge: ``count`` block indices I and coefficients nu (both
-  PRF-derived from the round randomness, mirroring audit's 46/1000
-  coverage and 20-byte randoms).
+- TagGen (TEE secret key (alpha[sectors, 2], prf_key)): alpha and the
+  PRF live in F_p^2 = F_p[i]/(i^2+1) (p == 3 mod 4 so irreducible);
+  data m and challenge coefficients nu stay in the base field, so
+  every F_p^2 operation used below is COMPONENTWISE — two
+  independently-keyed copies of the base-field MAC, one per limb:
+      tag[b] = f_k(fragment_id, b) + sum_j alpha[j] * m[b, j]  in F_p^2
+  (tags are [blocks, 2] uint32).
+- Challenge: ``count`` block indices I and coefficients nu in F_p
+  (both PRF-derived from the round randomness, mirroring audit's
+  46/1000 coverage and 20-byte randoms).
 - Prove (miner, needs only data + tags, no secrets):
-      mu[j]  = sum_{i in I} nu[i] * m[I[i], j]   (mod p)
-      sigma  = sum_{i in I} nu[i] * tag[I[i]]    (mod p)
-  Proof size = (sectors + 1) * 4 bytes = 1028 <= 2048 = SIGMA_MAX.
-- Verify (TEE):
+      mu[j]  = sum_{i in I} nu[i] * m[I[i], j]   (mod p, base field)
+      sigma  = sum_{i in I} nu[i] * tag[I[i]]    (componentwise, F_p^2)
+  Proof size = (sectors + 2) * 4 bytes = 1032 <= 2048 = SIGMA_MAX.
+- Verify (TEE), one equation per limb, BOTH must hold:
       sigma ?= sum_i nu[i] * f_k(id, I[i]) + sum_j alpha[j] * mu[j]
+
+SOUNDNESS: a forged (mu', sigma') with mu' != mu must hit
+sum_j alpha_j (mu'_j - mu_j) in F_p^2 with alpha unknown and uniform:
+acceptance probability p^-2 ~= 2^-62 per verification (vs ~2^-31 for
+the r03 single-equation scheme; the reference's BLS check is ~2^-128
+but needs pairings, /root/reference/utils/verify-bls-signatures/
+src/lib.rs:1-247 via primitives/enclave-verify/src/lib.rs:230-235).
+Grinding headroom: at 8000 miners x 14400 rounds/day (caps from
+runtime/src/lib.rs:988) a 2^-62 break still needs ~10^11 years.
 
 Everything is batch-first over a fragment axis and jit/vmap/pjit-able;
 the byte/block axis shards across the mesh with psum aggregation
@@ -45,7 +60,8 @@ from . import pfield as pf
 
 SECTORS = 256                       # field elements per block
 BLOCK_BYTES = SECTORS * pf.BYTES_PER_ELEM   # 512
-PROOF_BYTES = (SECTORS + 1) * 4     # mu + sigma, 1028 <= SIGMA_MAX
+LIMBS = 2                           # F_p^2: two base-field MAC limbs
+PROOF_BYTES = (SECTORS + LIMBS) * 4   # mu + sigma, 1032 <= SIGMA_MAX
 assert PROOF_BYTES <= constants.SIGMA_MAX
 
 
@@ -66,14 +82,15 @@ class Podr2Key:
     public handle; private verification keeps the whole key in the TEE,
     SURVEY.md §2.1 tee-worker)."""
 
-    alpha: jax.Array        # [sectors] uint32 in [0, p)
+    alpha: jax.Array        # [sectors, LIMBS] uint32 in [0, p): F_p^2
     prf_key: jax.Array      # jax PRNG key
 
     @staticmethod
     def generate(seed: int, params: Podr2Params = Podr2Params()) -> "Podr2Key":
         root = jax.random.key(seed)
         k_alpha, k_prf = jax.random.split(root)
-        alpha = pf.to_field(jax.random.bits(k_alpha, (params.sectors,), jnp.uint32))
+        alpha = pf.to_field(
+            jax.random.bits(k_alpha, (params.sectors, LIMBS), jnp.uint32))
         return Podr2Key(alpha=alpha, prf_key=k_prf)
 
 
@@ -91,7 +108,7 @@ def fragment_id_from_hash(fragment_hash: bytes) -> np.ndarray:
 
 
 def prf_elems(prf_key, fragment_id, n: int):
-    """f_k(fragment_id, 0..n-1): per-block PRF values in F_p.
+    """f_k(fragment_id, 0..n-1): per-block PRF values in F_p^2 [n, 2].
 
     fragment_id is a (possibly 64-bit) integer, folded in as two 32-bit
     words. threefry is counter-based and platform-deterministic, so CPU
@@ -111,12 +128,15 @@ def prf_elems(prf_key, fragment_id, n: int):
         else:                                      # plain 32-bit scalar id
             lo, hi = fid.astype(jnp.uint32), jnp.uint32(0)
     key = jax.random.fold_in(jax.random.fold_in(prf_key, lo), hi)
-    return pf.to_field(jax.random.bits(key, (n,), jnp.uint32))
+    return pf.to_field(jax.random.bits(key, (n, LIMBS), jnp.uint32))
 
 
 def tag_from_elems(alpha, f, m):
-    """tags [B] from PRF slice f [B] and packed data m [B, s]."""
-    return pf.addmod(f, pf.dotmod(m, alpha[None, :], axis=-1))
+    """tags [B, 2] from PRF slice f [B, 2] and packed data m [B, s].
+
+    m is base-field, alpha [s, 2] is F_p^2: the product is
+    componentwise, so each limb is an independent base-field MAC."""
+    return pf.addmod(f, pf.dotmod(m[..., None], alpha[None, :, :], axis=-2))
 
 
 def fragment_to_elems(fragment, sectors: int = SECTORS):
@@ -127,13 +147,13 @@ def fragment_to_elems(fragment, sectors: int = SECTORS):
 
 
 def tag_fragment(key: Podr2Key, fragment_id, fragment) -> jax.Array:
-    """Tags for one fragment: uint8 [fragment_bytes] -> uint32 [blocks]."""
+    """Tags for one fragment: uint8 [fragment_bytes] -> uint32 [blocks, 2]."""
     m = fragment_to_elems(fragment, key.alpha.shape[0])     # [B, s]
     return tag_from_elems(key.alpha, prf_elems(key.prf_key, fragment_id, m.shape[0]), m)
 
 
 def tag_fragments(key: Podr2Key, fragment_ids, fragments) -> jax.Array:
-    """Batched tag-gen: ids [F], fragments [F, fragment_bytes] -> [F, blocks]."""
+    """Batched tag-gen: ids [F], fragments [F, fragment_bytes] -> [F, blocks, 2]."""
     return jax.vmap(lambda i, d: tag_fragment(key, i, d))(fragment_ids, fragments)
 
 
@@ -167,19 +187,19 @@ def gen_challenge(seed_bytes: bytes | int, num_blocks: int,
 
 
 def prove(fragment, tags, idx, nu, sectors: int = SECTORS):
-    """Miner-side proof for one fragment -> (mu [sectors], sigma []).
+    """Miner-side proof for one fragment -> (mu [sectors], sigma [2]).
 
-    Needs only public data: the fragment bytes and its tags.
+    Needs only public data: the fragment bytes and its tags [blocks, 2].
     """
     m = fragment_to_elems(fragment, sectors)       # [B, s]
     m_i = jnp.take(m, idx, axis=0)                 # [c, s]
     mu = pf.summod(pf.mulmod(nu[:, None], m_i), axis=0)     # [s]
-    sigma = pf.dotmod(nu, jnp.take(tags, idx, axis=0), axis=0)
+    sigma = pf.dotmod(nu[:, None], jnp.take(tags, idx, axis=0), axis=0)
     return mu, sigma
 
 
 def prove_batch(fragments, tags, idx, nu, sectors: int = SECTORS):
-    """[F, bytes], [F, blocks] -> (mu [F, sectors], sigma [F])."""
+    """[F, bytes], [F, blocks, 2] -> (mu [F, sectors], sigma [F, 2])."""
     return jax.vmap(lambda d, t: prove(d, t, idx, nu, sectors))(fragments, tags)
 
 
@@ -215,36 +235,39 @@ def aggregate_coeffs(seed_bytes: bytes, fragment_ids) -> jax.Array:
 
 
 def prove_aggregate(fragments, tags, idx, nu, r, sectors: int = SECTORS):
-    """[F, bytes], [F, blocks], r [F] -> (mu [sectors], sigma []).
+    """[F, bytes], [F, blocks, 2], r [F] -> (mu [sectors], sigma [2]).
 
     The constant-size aggregated proof across all of a miner's
     challenged fragments (see aggregate_coeffs)."""
     mu_f, sigma_f = prove_batch(fragments, tags, idx, nu, sectors)
     mu = pf.summod(pf.mulmod(r[:, None], mu_f), axis=0)
-    sigma = pf.dotmod(r, sigma_f, axis=0)
+    sigma = pf.dotmod(r[:, None], sigma_f, axis=0)
     return mu, sigma
 
 
 def verify_aggregate(key: Podr2Key, fragment_ids, num_blocks: int,
                      idx, nu, r, mu, sigma):
     """TEE-side check of an aggregated proof against the owed fragment
-    set (ids [F, 2]). Returns a scalar bool."""
+    set (ids [F, 2]). Returns a scalar bool — true only when BOTH
+    F_p^2 limb equations hold (soundness ~p^-2, see module doc)."""
     ids = jnp.asarray(fragment_ids).reshape(-1, 2)
     f_all = jax.vmap(
-        lambda i: prf_elems(key.prf_key, i, num_blocks))(ids)   # [F, B]
+        lambda i: prf_elems(key.prf_key, i, num_blocks))(ids)   # [F, B, 2]
     lhs_f = jax.vmap(
-        lambda f: pf.dotmod(nu, jnp.take(f, idx, axis=0), axis=0))(f_all)
-    lhs = pf.addmod(pf.dotmod(r, lhs_f, axis=0),
-                    pf.dotmod(key.alpha, mu, axis=0))
-    return lhs == sigma
+        lambda f: pf.dotmod(nu[:, None], jnp.take(f, idx, axis=0), axis=0)
+    )(f_all)                                                    # [F, 2]
+    lhs = pf.addmod(pf.dotmod(r[:, None], lhs_f, axis=0),
+                    pf.dotmod(key.alpha, mu[:, None], axis=0))
+    return jnp.all(lhs == jnp.asarray(sigma))
 
 
 def verify_from_f(alpha, f, idx, nu, mu, sigma):
-    """The verification equation given precomputed PRF values f [blocks]
-    (shared by single-device verify and the sharded mesh step)."""
-    lhs = pf.dotmod(nu, jnp.take(f, idx, axis=0), axis=0)
-    rhs = pf.dotmod(alpha, mu, axis=0)
-    return pf.addmod(lhs, rhs) == sigma
+    """The verification equation given precomputed PRF values
+    f [blocks, 2] (shared by single-device verify and the sharded mesh
+    step). Both limb equations must hold."""
+    lhs = pf.dotmod(nu[:, None], jnp.take(f, idx, axis=0), axis=0)   # [2]
+    rhs = pf.dotmod(alpha, mu[:, None], axis=0)                      # [2]
+    return jnp.all(pf.addmod(lhs, rhs) == sigma)
 
 
 def verify(key: Podr2Key, fragment_id, num_blocks: int, idx, nu, mu, sigma):

@@ -58,7 +58,8 @@ def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
             program: a fixed challenge would let a prover store only the
             challenged blocks).
     Output: fragments [B, k+m, n] (sharded same as input),
-            tags [B, k+m, blocks] (block axis sharded over 'byte'),
+            tags [B, k+m, blocks, 2] (block axis sharded over 'byte';
+            trailing axis = the two F_p^2 MAC limbs, replicated),
             ok [B, k+m] bool verification verdicts (replicated).
     """
     cfg = pipeline.config
@@ -85,16 +86,16 @@ def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
             lambda i: podr2.prf_elems(key.prf_key, i, blocks_total))(frag_ids)
         f_loc = jax.lax.dynamic_slice_in_dim(f_all, off, blocks_local, axis=1)
         tags = jax.vmap(podr2.tag_from_elems, in_axes=(None, 0, 0))(
-            key.alpha, f_loc, m)                               # [F, bl_local]
+            key.alpha, f_loc, m)                               # [F, bl_local, 2]
 
         # --- prove: masked local partials, psum over 'byte' ---------------
         in_range = (idx >= off) & (idx < off + blocks_local)
         local_idx = jnp.clip(idx - off, 0, blocks_local - 1)
         w = jnp.where(in_range, nu, 0).astype(jnp.uint32)      # [c]
         m_c = jnp.take(m, local_idx, axis=1)                   # [F, c, s]
-        t_c = jnp.take(tags, local_idx, axis=1)                # [F, c]
+        t_c = jnp.take(tags, local_idx, axis=1)                # [F, c, 2]
         mu_part = pf.summod(pf.mulmod(w[None, :, None], m_c), axis=1)   # [F, s]
-        sg_part = pf.summod(pf.mulmod(w[None, :], t_c), axis=1)         # [F]
+        sg_part = pf.summod(pf.mulmod(w[None, :, None], t_c), axis=1)   # [F, 2]
         mu = pf.psum_mod(mu_part, "byte")
         sigma = pf.psum_mod(sg_part, "byte")
 
@@ -103,14 +104,14 @@ def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
             lambda fa, u, s: podr2.verify_from_f(key.alpha, fa, idx, nu, u, s)
         )(f_all, mu, sigma)
 
-        return (shards, tags.reshape(b, rows, blocks_local),
+        return (shards, tags.reshape(b, rows, blocks_local, 2),
                 ok.reshape(b, rows))
 
     mapped = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P("seg", None, "byte"), P("seg", None), P(), P()),
-        out_specs=(P("seg", None, "byte"), P("seg", None, "byte"),
+        out_specs=(P("seg", None, "byte"), P("seg", None, "byte", None),
                    P("seg", None)),
     )
     return jax.jit(mapped)

@@ -54,8 +54,9 @@ def test_sharded_proof_matches_single_device(setup):
     b, rows, n = frags.shape
     blocks = cfg.blocks_per_fragment
     idx, nu = podr2.gen_challenge(b"single-device-round", blocks)
-    mu, sigma = podr2.prove_batch(frags.reshape(b * rows, n),
-                                  tags.reshape(b * rows, blocks), idx, nu)
+    mu, sigma = podr2.prove_batch(
+        frags.reshape(b * rows, n),
+        tags.reshape(b * rows, blocks, podr2.LIMBS), idx, nu)
     ok = podr2.verify_batch(pipe.podr2_key, jnp.asarray(ids).reshape(-1),
                             blocks, idx, nu, mu, sigma)
     assert np.asarray(ok).all()
