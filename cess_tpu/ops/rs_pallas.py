@@ -7,9 +7,25 @@ HBM — ~5.8 GiB/s on v5e. This kernel fuses the whole chain
 tiled along the byte axis, so HBM traffic is just the uint8 input and
 output rows.
 
-Layout contract: data [..., q, n] uint8 is viewed as [B*q, n] (segment
-rows are contiguous); the grid walks (segment, column-tile) and each
-step applies the (8r x 8q) GF(2) bit-matrix to one (q x TILE_N) tile.
+Round-4 probe findings (tools/probe2.py, v5e, 2 GiB resident batches —
+smaller batches are dispatch-bound through the axon tunnel and mask
+kernel differences):
+- throughput was FLAT across group {1,2,4,8} x tile {8k..128k} x
+  subtile interleave {1,2,4}: the kernel is NOT MXU-slot-bound, so
+  kron-segment-grouping buys nothing (levers kept as tuning knobs);
+- the VPU byte-PACK (bit-parity -> weighted sublane reduction) cost
+  ~45% of runtime: skipping it measured 42.2 GiB/s vs 23.4 full;
+- hence ``mxupack``: the pack is a SECOND small int8 matmul — packed
+  byte = sum_b w_b * parity_b with w = [1,2,4,8,16,32,64,-128] (the
+  -128 exploits two's-complement wraparound of the uint8 cast), so
+  the sublane reduction rides the idle MXU instead of the VPU;
+- iota-broadcast bit ops are the fast VPU lowering: jnp.stack of 8
+  strided slices forces sublane relayouts, measured ~3x slower.
+
+Layout contract: data [..., q, n] uint8 is viewed as [B, q, n] (segment
+rows are contiguous); the grid walks (segment-group, column-tile) and
+each step applies the (8rg x 8qg) GF(2) block-diagonal bit-matrix
+``kron(I_group, expand_bitmatrix(mat))`` to one (g x q x TILE_N) tile.
 """
 from __future__ import annotations
 
@@ -21,60 +37,97 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_TILE_N = 32768  # v5e sweep: 8k..64k within ~4%, 32k the sweet spot
+DEFAULT_TILE_N = 32768
+DEFAULT_GROUP = 2      # v5e probe: mxupack g=2/32k 51.9 GiB/s, the peak
+DEFAULT_SUBTILES = 1
+PACK_W = (1, 2, 4, 8, 16, 32, 64, -128)   # int8-safe byte weights
 
 
-def _make_kernel(q: int, r: int, tile_n: int, acc_dtype):
+def _make_kernel(q: int, r: int, g: int, tile_n: int, subtiles: int,
+                 acc_dtype, mxu_pack: bool):
     op_dtype = jnp.bfloat16 if acc_dtype == jnp.float32 else jnp.int8
+    ts = tile_n // subtiles
 
-    def kernel(bmat_ref, data_ref, out_ref):
-        data = data_ref[0].astype(jnp.int32)  # [q, T]
-        # unpack bit-planes: row 8j+b = bit b of byte row j
-        shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
-        bits = (data[:, None, :] >> shifts) & 1          # [q, 8, T]
-        bits = bits.reshape(8 * q, tile_n).astype(op_dtype)
-        prod = jnp.dot(bmat_ref[:], bits, preferred_element_type=acc_dtype)
-        obits = prod.astype(jnp.int32) & 1               # parity == XOR-accumulate
-        obits = obits.reshape(r, 8, tile_n)
-        weights = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
-        packed = jnp.sum(obits << weights, axis=1)       # [r, T]
-        out_ref[0] = packed.astype(jnp.uint8)
+    def kernel(bmat_ref, pack_ref, data_ref, out_ref):
+        for s in range(subtiles):
+            sl = slice(s * ts, (s + 1) * ts)
+            data = data_ref[:, :, sl].astype(jnp.int32)      # [g, q, ts]
+            # unpack bit-planes: contraction row g_i*8q + 8j + b
+            shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8, 1), 2)
+            bits = (data[:, :, None, :] >> shifts) & 1       # [g, q, 8, ts]
+            bits = bits.reshape(8 * q * g, ts).astype(op_dtype)
+            prod = jnp.dot(bmat_ref[:], bits,
+                           preferred_element_type=acc_dtype)
+            if mxu_pack:
+                y = (prod.astype(jnp.int32) & 1).astype(jnp.int8)
+                packed = jnp.dot(pack_ref[:], y,
+                                 preferred_element_type=jnp.int32)
+                out_ref[:, :, sl] = packed.reshape(
+                    g, r, ts).astype(jnp.uint8)
+            else:
+                obits = prod.astype(jnp.int32) & 1           # parity == XOR
+                obits = obits.reshape(g, r, 8, ts)
+                weights = jax.lax.broadcasted_iota(
+                    jnp.int32, (1, 1, 8, 1), 2)
+                packed = jnp.sum(obits << weights, axis=2)   # [g, r, ts]
+                out_ref[:, :, sl] = packed.astype(jnp.uint8)
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
-def _apply_3d(bmat: jax.Array, q: int, r: int, tile_n: int, use_int8: bool,
-              data3d: jax.Array) -> jax.Array:
-    """bmat [8r, 8q]; data3d [B, q, n] -> [B, r, n]."""
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 9))
+def _apply_3d(bmat: jax.Array, packmat: jax.Array, q: int, r: int, g: int,
+              tile_n: int, subtiles: int, use_int8: bool,
+              data3d: jax.Array, mxu_pack: bool) -> jax.Array:
+    """bmat [8rg, 8qg] block-diag; data3d [B, q, n] -> [B, r, n]."""
     b, _, n = data3d.shape
     acc_dtype = jnp.int32 if use_int8 else jnp.float32
-    kernel = _make_kernel(q, r, tile_n, acc_dtype)
-    grid = (b, n // tile_n)
+    kernel = _make_kernel(q, r, g, tile_n, subtiles, acc_dtype, mxu_pack)
+    grid = (b // g, n // tile_n)
     # interpret mode lets the same kernel run on the CPU test mesh
     interpret = jax.default_backend() == "cpu"
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((8 * r, 8 * q), lambda i, t: (0, 0),
+            pl.BlockSpec((8 * r * g, 8 * q * g), lambda i, t: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, q, tile_n), lambda i, t: (i, 0, t),
+            pl.BlockSpec((r * g, 8 * r * g), lambda i, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((g, q, tile_n), lambda i, t: (i, 0, t),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, r, tile_n), lambda i, t: (i, 0, t),
+        out_specs=pl.BlockSpec((g, r, tile_n), lambda i, t: (i, 0, t),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, r, n), jnp.uint8),
         interpret=interpret,
-    )(bmat, data3d)
+    )(bmat, packmat, data3d)
+
+
+@functools.lru_cache(maxsize=64)
+def _matrices_np(bmat_key, g: int, r: int,
+                 use_int8: bool) -> tuple[np.ndarray, np.ndarray]:
+    # cache NUMPY only: a jnp array created inside a jit trace would be
+    # a tracer, and caching a tracer leaks it across traces
+    bmat_np = np.frombuffer(bmat_key[2], dtype=np.uint8).reshape(bmat_key[:2])
+    big = np.kron(np.eye(g, dtype=np.uint8), bmat_np)
+    big = big.astype(np.int8) if use_int8 else big.astype(np.float32)
+    # pack matrix [rg, 8rg]: row i selects its 8 bit-rows with weights
+    pack = np.kron(np.eye(r * g, dtype=np.int8),
+                   np.asarray(PACK_W, dtype=np.int8)[None, :])
+    return big, pack
 
 
 def apply_bitmatrix(bmat_np: np.ndarray, data: jax.Array,
-                    tile_n: int = DEFAULT_TILE_N, use_int8: bool = True) -> jax.Array:
+                    tile_n: int = DEFAULT_TILE_N, use_int8: bool = True,
+                    group: int = DEFAULT_GROUP,
+                    subtiles: int = DEFAULT_SUBTILES,
+                    mxu_pack: bool = True) -> jax.Array:
     """Apply an expanded (8r x 8q) GF(2) bit-matrix to [..., q, n] uint8 data.
 
     Returns [..., r, n] uint8. n is padded to a multiple of tile_n if
-    needed (zero columns encode to zero parity — harmless, stripped).
+    needed (zero columns encode to zero parity — harmless, stripped);
+    ``group`` degrades to the largest divisor of the flattened batch.
     """
     r8, q8 = bmat_np.shape
     q, r = q8 // 8, r8 // 8
@@ -85,10 +138,21 @@ def apply_bitmatrix(bmat_np: np.ndarray, data: jax.Array,
     if pad:
         data = jnp.pad(data, [(0, 0)] * len(lead) + [(0, 0), (0, pad)])
     flat = data.reshape(-1, q, data.shape[-1])  # [B, q, n_pad]
-    op_dtype = np.int8 if use_int8 else jnp.bfloat16
-    bmat = jnp.asarray(bmat_np.astype(np.int8) if use_int8 else bmat_np,
-                       dtype=op_dtype)
-    out = _apply_3d(bmat, q, r, tile_n, use_int8, flat)
+    g = group
+    while flat.shape[0] % g:
+        g //= 2
+    sub = subtiles
+    while tile_n % sub:
+        sub //= 2
+    bmat_u8 = np.ascontiguousarray(bmat_np.astype(np.uint8))
+    big_np, pack_np = _matrices_np(
+        (bmat_u8.shape[0], bmat_u8.shape[1], bmat_u8.tobytes()), g, r,
+        use_int8)
+    bmat = jnp.asarray(big_np,
+                       dtype=jnp.int8 if use_int8 else jnp.bfloat16)
+    packmat = jnp.asarray(pack_np)
+    out = _apply_3d(bmat, packmat, q, r, g, tile_n, sub, use_int8, flat,
+                    mxu_pack)
     out = out.reshape(*lead, r, data.shape[-1])
     if pad:
         out = out[..., :n]

@@ -1,0 +1,58 @@
+"""Probe kernel styles: iota vs const-shift vs nopack bound."""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from cess_tpu.ops import gf, rs_pallas
+
+    k, m = 4, 8
+    batch, seg = 128, 16 * 2**20
+    frag = seg // k
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    bmat = gf.expand_bitmatrix(gf.cauchy_parity_matrix(k, m))
+    rng = np.random.default_rng(0)
+    data_np = rng.integers(0, 256, (batch, k, frag), dtype=np.uint8)
+
+    def bench(style, g, tile, sub):
+        data = jnp.asarray(data_np)   # fresh: donation deletes the old one
+        mx = style == "mxupack"
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(carry):
+            d, salt = carry
+            d = d.at[0, 0, 0].set(salt)
+            p = rs_pallas.apply_bitmatrix(bmat, d, tile_n=tile,
+                                          group=g, subtiles=sub,
+                                          mxu_pack=mx)
+            return d, p[0, 0, 0]
+
+        carry = step((data, jnp.uint8(0)))
+        _ = np.asarray(carry[-1])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            carry = step(carry)
+        _ = np.asarray(carry[-1])
+        dt = (time.perf_counter() - t0) / iters
+        return batch * seg / 2**30 / dt
+
+    import ast
+    cfgs = ast.literal_eval(sys.argv[2]) if len(sys.argv) > 2 else (
+        ("mxupack", 1, 32768, 1), ("mxupack", 2, 32768, 1),
+        ("mxupack", 4, 16384, 1))
+    for style, g, tile, sub in cfgs:
+        v = bench(style, g, tile, sub)
+        print(f"{style} g={g} tile={tile} sub={sub}: {v:.1f} GiB/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Quick A/B probe: host-chained vs in-jit-loop timing of the encode
+kernel for a handful of configs. Diagnoses dispatch-bound vs
+device-bound measurements through the axon tunnel."""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from cess_tpu.ops import gf, rs_pallas
+
+    k, m = 4, 8
+    batch, seg = 128, 16 * 2**20   # 2 GiB/step: amortize tunnel dispatch
+    frag = seg // k
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+    bmat = gf.expand_bitmatrix(gf.cauchy_parity_matrix(k, m))
+    rng = np.random.default_rng(0)
+    data0 = rng.integers(0, 256, (batch, k, frag), dtype=np.uint8)
+
+    def bench_host(g, tile, sub):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(carry):
+            d, salt = carry
+            d = d.at[0, 0, 0].set(salt)
+            p = rs_pallas.apply_bitmatrix(bmat, d, tile_n=tile,
+                                          group=g, subtiles=sub)
+            return d, p[0, 0, 0]
+
+        carry = step((jnp.asarray(data0), jnp.uint8(0)))
+        _ = np.asarray(carry[-1])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            carry = step(carry)
+        _ = np.asarray(carry[-1])
+        dt = (time.perf_counter() - t0) / iters
+        return batch * seg / 2**30 / dt
+
+    def bench_loop(g, tile, sub):
+        @functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(2,))
+        def run(d, salt, n):
+            def body(_, carry):
+                d, salt = carry
+                d = d.at[0, 0, 0].set(salt)
+                p = rs_pallas.apply_bitmatrix(bmat, d, tile_n=tile,
+                                              group=g, subtiles=sub)
+                return d, p[0, 0, 0]
+            return jax.lax.fori_loop(0, n, body, (d, salt))
+
+        d, salt = run(jnp.asarray(data0), jnp.uint8(0), 1)
+        _ = np.asarray(salt)
+        t0 = time.perf_counter()
+        d, salt = run(d, salt, iters)
+        _ = np.asarray(salt)
+        dt = (time.perf_counter() - t0) / iters
+        return batch * seg / 2**30 / dt
+
+    for g, tile, sub in ((1, 32768, 1), (2, 16384, 1), (2, 32768, 1),
+                         (4, 16384, 1), (4, 16384, 4), (4, 8192, 1),
+                         (8, 8192, 1)):
+        h = bench_host(g, tile, sub)
+        print(f"g={g} tile={tile} sub={sub}: host-chained {h:.1f} GiB/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

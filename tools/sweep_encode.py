@@ -1,0 +1,96 @@
+"""Sweep the Pallas encode-kernel config space on the real chip.
+
+Usage: python tools/sweep_encode.py [--iters 20]
+Prints GiB/s (data-in) for each (group, tile_n, subtiles, dtype) combo
+using the same chained-timer methodology as bench.py, plus a
+correctness check of every combo against the NumPy oracle.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import itertools
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seg", type=int, default=16 * 2**20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from cess_tpu.ops import gf, rs_pallas
+    from cess_tpu.ops.rs_ref import ReferenceCodec
+
+    k, m = 4, 8
+    frag = args.seg // k
+    bmat = gf.expand_bitmatrix(gf.cauchy_parity_matrix(k, m))
+
+    rng = np.random.default_rng(0)
+    data_np = rng.integers(0, 256, (args.batch, k, frag), dtype=np.uint8)
+    data = jnp.asarray(data_np)
+
+    # oracle on a SEPARATE small array (the bench buffer is donated +
+    # salted in place, so it must never feed the correctness check)
+    check_np = rng.integers(0, 256, (2, k, 4096), dtype=np.uint8)
+    check = jnp.asarray(check_np)
+    oracle = ReferenceCodec(k, m).encode_parity(check_np)
+
+    results = []
+    for g, tile, sub, int8 in itertools.product(
+            (1, 2, 4, 8), (8192, 16384, 32768), (1, 2, 4), (True,)):
+        if (g * (k + 2 * m) * tile) * 4 > 96 * 2**20:  # rough VMEM guard
+            continue
+        try:
+            got = np.asarray(rs_pallas.apply_bitmatrix(
+                bmat, check, tile_n=4096, use_int8=int8,
+                group=min(g, 2), subtiles=sub))
+            assert np.array_equal(got, oracle), "MISMATCH"
+
+            # iteration loop INSIDE the jit: a loaded 1-core host
+            # cannot keep per-iter dispatch ahead of ~20 ms of device
+            # compute through the tunnel, so host-side chaining
+            # under-measures the kernel. Each iteration's input
+            # depends on the previous parity (salt), so nothing is
+            # hoisted or dead-code-eliminated.
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               static_argnums=(2,))
+            def run(d, salt, iters, _g=g, _t=tile, _s=sub, _i=int8):
+                def body(_, carry):
+                    d, salt = carry
+                    d = d.at[0, 0, 0].set(salt)
+                    p = rs_pallas.apply_bitmatrix(
+                        bmat, d, tile_n=_t, use_int8=_i, group=_g,
+                        subtiles=_s)
+                    return d, p[0, 0, 0]
+                return jax.lax.fori_loop(0, iters, body, (d, salt))
+
+            data, salt = run(data, jnp.uint8(0), 1)   # compile + warm
+            _ = np.asarray(salt)
+            t0 = time.perf_counter()
+            data, salt = run(data, salt, args.iters)
+            _ = np.asarray(salt)
+            dt = (time.perf_counter() - t0) / args.iters
+            gibps = args.batch * args.seg / 2**30 / dt
+            results.append((gibps, g, tile, sub, int8))
+            print(f"g={g} tile={tile} sub={sub} int8={int8}: "
+                  f"{gibps:.1f} GiB/s", flush=True)
+        except Exception as e:  # noqa: BLE001 — sweep survives bad configs
+            print(f"g={g} tile={tile} sub={sub} int8={int8}: FAIL "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+            data = jnp.asarray(data_np)
+
+    results.sort(reverse=True)
+    print("\nTop 5:")
+    for gibps, g, tile, sub, int8 in results[:5]:
+        print(f"  {gibps:.1f} GiB/s  g={g} tile={tile} sub={sub} int8={int8}")
+
+
+if __name__ == "__main__":
+    main()
