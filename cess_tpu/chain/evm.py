@@ -1,35 +1,38 @@
-"""EVM / contracts capability boundary (Frontier stub).
+"""EVM capability boundary: accounts, contracts, real execution.
 
 The reference embeds the Frontier EVM stack + Wasm contracts
-(/root/reference/runtime/src/lib.rs:1524-1528: Contracts, Ethereum,
-EVM, DynamicFee, BaseFee; node-side Frontier DB + RPC workers,
-node/src/service.rs:56-81,392-429). SURVEY.md §2.3 scopes this as
-"port as optional module or stub behind the same API boundary" — out
-of the TPU hot path.
+(/root/reference/runtime/src/lib.rs:1310-1380,1524-1528: Contracts,
+Ethereum, EVM, DynamicFee, BaseFee; node-side Frontier DB + RPC
+workers, node/src/service.rs:56-81,392-429). This module is the same
+boundary with a framework-native engine behind it
+(cess_tpu/chain/evm_interp.py): deploy runs INIT code and stores the
+returned runtime code; call/query execute the core opcode set with gas
+metering; contract storage lives in the chain KV; LOG0-4 entries are
+archived per block for eth_getLogs. Anything beyond the engine's
+surface (inter-contract CALL/CREATE) fails with ``evm.NotSupported`` —
+a typed capability refusal, not an AttributeError.
 
-This module IS that boundary: the dispatch surface (deploy / call /
-query / account basics) exists with the reference's shape, maintains
-EVM account + code storage, and executes a deliberately minimal
-subset; anything beyond it fails with ``evm.NotSupported`` — a typed
-capability refusal, not an AttributeError. A full interpreter (or a
-bridge) slots in behind this exact surface without touching callers.
-
-Supported today: code storage/retrieval, balance transfers into/out of
-the EVM domain (the pallet-evm withdraw/deposit analog), and STOP/
-RETURN-of-calldata bytecode (enough to round-trip deploy->call->query
-in tests). Everything else: NotSupported.
+Gas bounds block work: every call carries a gas limit capped at
+GAS_CAP, so a looping contract burns its gas and reverts — block
+production can never stall (tested in tests/test_evm.py).
 """
 from __future__ import annotations
 
 import hashlib
 
+from . import evm_interp
+from .evm_interp import EvmError, EvmRevert
 from .state import DispatchError, State
 
 PALLET = "evm"
+GAS_CAP = 5_000_000       # per-call ceiling (block-stall bound)
+DEFAULT_GAS = 1_000_000
+MAX_CODE = 64 * 1024
 
-# one-byte "opcodes" of the minimal executable subset
-OP_STOP = 0x00
-OP_ECHO = 0xFE   # returns calldata (test/diagnostic contract)
+
+def eth_address(who: str) -> bytes:
+    """Deterministic 20-byte EVM address for a native account."""
+    return hashlib.sha256(b"evm-addr:" + who.encode()).digest()[:20]
 
 
 class Evm:
@@ -60,55 +63,140 @@ class Evm:
     def balance(self, who: str) -> int:
         return self.state.get(PALLET, "balance", who, default=0)
 
+    # -- storage bridge -------------------------------------------------------
+    def _sload(self, addr: bytes):
+        return lambda k: self.state.get(PALLET, "storage", addr, k,
+                                        default=0)
+
+    def _sstore(self, addr: bytes):
+        def store(k: int, v: int) -> None:
+            if v == 0:
+                self.state.delete(PALLET, "storage", addr, k)
+            else:
+                self.state.put(PALLET, "storage", addr, k, v)
+        return store
+
+    def storage_at(self, address: bytes, key: int) -> int:
+        return self.state.get(PALLET, "storage", address, key, default=0)
+
     # -- contracts -----------------------------------------------------------
-    def deploy(self, who: str, code: bytes) -> bytes:
-        """Store contract code; returns the contract address
-        (CREATE-address analog: hash of deployer + nonce)."""
-        if not isinstance(code, bytes) or not code:
+    def deploy(self, who: str, code: bytes,
+               gas_limit: int = DEFAULT_GAS) -> bytes:
+        """Run INIT ``code``; its RETURN data becomes the contract's
+        runtime code at a CREATE-style address (hash of deployer +
+        nonce). Reverts/exceptional halts fail the dispatch."""
+        if not isinstance(code, bytes) or not code or len(code) > MAX_CODE:
             raise DispatchError("evm.InvalidCode")
+        gas_limit = self._check_gas(gas_limit)
         nonce = self.state.get(PALLET, "nonce", who, default=0)
         self.state.put(PALLET, "nonce", who, nonce + 1)
         addr = hashlib.sha256(b"evm-create:" + who.encode()
                               + nonce.to_bytes(8, "little")).digest()[:20]
-        self.state.put(PALLET, "code", addr, code)
+        try:
+            res = evm_interp.execute(
+                code, calldata=b"", caller=eth_address(who), address=addr,
+                gas_limit=gas_limit,
+                sload=self._sload(addr), sstore=self._sstore(addr))
+        except EvmRevert as e:
+            raise DispatchError("evm.Reverted", e.data.hex()) from e
+        except EvmError as e:
+            raise DispatchError("evm.ExecutionFailed", str(e)) from e
+        runtime = res.output
+        if len(runtime) > MAX_CODE:
+            raise DispatchError("evm.InvalidCode", "runtime too large")
+        self.state.put(PALLET, "code", addr, runtime)
+        self._archive_logs(res.logs)
         self.state.deposit_event(PALLET, "Deployed", who=who,
-                                 address=addr, code_len=len(code))
+                                 address=addr, code_len=len(runtime),
+                                 gas_used=res.gas_used)
         return addr
 
     def code_at(self, address: bytes) -> bytes | None:
         return self.state.get(PALLET, "code", address)
 
-    def call(self, who: str, address: bytes, calldata: bytes) -> bytes:
-        """Execute a contract call. Only the minimal subset runs;
-        real bytecode gets the typed capability refusal."""
+    def _check_gas(self, gas_limit) -> int:
+        if not isinstance(gas_limit, int) or gas_limit <= 0:
+            raise DispatchError("evm.InvalidGas")
+        return min(gas_limit, GAS_CAP)
+
+    def call(self, who: str, address: bytes, calldata: bytes,
+             gas_limit: int = DEFAULT_GAS) -> bytes:
+        """Execute a contract call; storage writes + logs commit with
+        the surrounding dispatch transaction."""
         code = self.code_at(address)
         if code is None:
             raise DispatchError("evm.NoContract")
         if not isinstance(calldata, bytes):
             raise DispatchError("evm.InvalidCall")
-        op = code[0]
-        if op == OP_STOP:
-            out = b""
-        elif op == OP_ECHO:
-            out = calldata
-        else:
-            raise DispatchError(
-                "evm.NotSupported",
-                f"opcode 0x{op:02x}: full EVM execution is behind this "
-                "boundary but not implemented")
+        gas_limit = self._check_gas(gas_limit)
+        try:
+            res = evm_interp.execute(
+                code, calldata=calldata, caller=eth_address(who),
+                address=address, gas_limit=gas_limit,
+                sload=self._sload(address), sstore=self._sstore(address))
+        except EvmRevert as e:
+            raise DispatchError("evm.Reverted", e.data.hex()) from e
+        except EvmError as e:
+            raise DispatchError("evm.ExecutionFailed", str(e)) from e
+        self._archive_logs(res.logs)
         self.state.deposit_event(PALLET, "Called", who=who,
-                                 address=address, out_len=len(out))
-        return out
+                                 address=address, out_len=len(res.output),
+                                 gas_used=res.gas_used)
+        return res.output
 
-    def query(self, address: bytes, calldata: bytes) -> bytes:
-        """Read-only call (eth_call analog): same execution surface,
-        no events, no state writes committed by the caller."""
+    def query(self, address: bytes, calldata: bytes,
+              caller: str = "", gas_limit: int = DEFAULT_GAS) -> bytes:
+        """Read-only call (eth_call analog): same engine, storage reads
+        come from chain state, writes go to a throwaway overlay, no
+        events or logs are archived."""
         code = self.code_at(address)
         if code is None:
             raise DispatchError("evm.NoContract")
-        if code[0] == OP_STOP:
-            return b""
-        if code[0] == OP_ECHO:
-            return calldata
-        raise DispatchError("evm.NotSupported",
-                            f"opcode 0x{code[0]:02x}")
+        if not isinstance(calldata, bytes):
+            raise DispatchError("evm.InvalidCall")
+        gas_limit = self._check_gas(gas_limit)
+        overlay: dict[int, int] = {}
+        base = self._sload(address)
+
+        def sload(k: int) -> int:
+            return overlay[k] if k in overlay else base(k)
+
+        try:
+            res = evm_interp.execute(
+                code, calldata=calldata, caller=eth_address(caller),
+                address=address, gas_limit=gas_limit,
+                sload=sload, sstore=overlay.__setitem__)
+        except EvmRevert as e:
+            raise DispatchError("evm.Reverted", e.data.hex()) from e
+        except EvmError as e:
+            raise DispatchError("evm.ExecutionFailed", str(e)) from e
+        return res.output
+
+    # -- logs (eth_getLogs backing store) ------------------------------------
+    def _archive_logs(self, logs) -> None:
+        if not logs:
+            return
+        block = self.state.block
+        seq = self.state.get(PALLET, "log_seq", block, default=0)
+        for lg in logs:
+            self.state.put(PALLET, "logs", block, seq,
+                           (lg.address, tuple(lg.topics), lg.data))
+            seq += 1
+        self.state.put(PALLET, "log_seq", block, seq)
+
+    def logs_in_range(self, from_block: int, to_block: int,
+                      address: bytes | None = None) -> list[dict]:
+        """O(blocks in range + matches) via the per-block log_seq
+        index — never a scan of the whole archive."""
+        out = []
+        for blk in range(max(0, from_block), to_block + 1):
+            n = self.state.get(PALLET, "log_seq", blk, default=0)
+            for seq in range(n):
+                addr, topics, data = self.state.get(PALLET, "logs",
+                                                    blk, seq)
+                if address is not None and addr != address:
+                    continue
+                out.append({"blockNumber": blk, "logIndex": seq,
+                            "address": addr, "topics": list(topics),
+                            "data": data})
+        return out

@@ -256,7 +256,71 @@ class RpcServer:
         if method == "eth_call":
             if len(params) < 2:
                 raise RpcError(INVALID_PARAMS,
-                               "expected [address, calldata]")
+                               "expected [address, calldata, caller?]")
+            caller = params[2] if len(params) > 2 else ""
             return "0x" + rt.evm.query(_decode(params[0]),
-                                       _decode(params[1])).hex()
+                                       _decode(params[1]),
+                                       caller=caller).hex()
+        if method == "eth_sendRawTransaction":
+            # Frontier accepts RLP Ethereum txs (fp_self_contained,
+            # runtime/src/lib.rs:1576-1579); the framework-native wire
+            # is a codec-encoded SignedExtrinsic carrying an evm.* call
+            from .. import codec as _codec
+
+            if not params:
+                raise RpcError(INVALID_PARAMS, "expected [raw tx hex]")
+            xt = _codec.decode(_decode(params[0]))
+            if not getattr(xt, "call", "").startswith("evm."):
+                raise RpcError(INVALID_PARAMS,
+                               "raw tx must carry an evm.* call")
+            node.submit_signed(xt)
+            import hashlib as _hl
+
+            return "0x" + _hl.sha256(_codec.encode(xt)).hexdigest()
+        if method == "eth_getLogs":
+            flt = params[0] if params and isinstance(params[0], dict) \
+                else {}
+
+            def blocknum(v, default):
+                # standard Eth block tags + hex strings + plain ints
+                if v is None or v in ("latest", "pending"):
+                    return default
+                if v == "earliest":
+                    return 0
+                return int(v, 16) if isinstance(v, str) else int(v)
+
+            frm = blocknum(flt.get("fromBlock"), 0)
+            # clamp: an attacker-chosen huge toBlock must not spin the
+            # range loop while holding the node lock
+            to = min(blocknum(flt.get("toBlock"), rt.state.block),
+                     rt.state.block)
+            addr = flt.get("address")
+            addr = _decode(addr) if isinstance(addr, str) else None
+            logs = rt.evm.logs_in_range(frm, to, address=addr)
+            want_topics = flt.get("topics")
+            if want_topics:
+                def tmatch(lg):
+                    lt = lg["topics"]
+                    for i, want in enumerate(want_topics):
+                        if want is None:
+                            continue   # wildcard position
+                        opts = want if isinstance(want, list) else [want]
+                        opts = [_decode(o) if isinstance(o, str) else o
+                                for o in opts]
+                        if i >= len(lt) or lt[i] not in opts:
+                            return False
+                    return True
+
+                logs = [lg for lg in logs if tmatch(lg)]
+            return logs
+        if method == "eth_getTransactionCount":
+            if not params or not isinstance(params[0], str):
+                raise RpcError(INVALID_PARAMS, "expected [account]")
+            return hex(rt.system.nonce(params[0]))
+        if method == "eth_getStorageAt":
+            if len(params) < 2:
+                raise RpcError(INVALID_PARAMS, "expected [address, slot]")
+            slot = params[1]
+            slot = int(slot, 16) if isinstance(slot, str) else int(slot)
+            return hex(rt.evm.storage_at(_decode(params[0]), slot))
         raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")

@@ -1,0 +1,222 @@
+"""EVM execution engine: ERC-20-style round trip, gas bounds, logs,
+and the eth_* RPC surface (VERDICT r3 Missing #3 done-criteria:
+deploy -> transfer -> balanceOf via eth_call, eth_sendRawTransaction,
+eth_getLogs; ref runtime/src/lib.rs:1310-1380, node/src/rpc.rs:229-328).
+"""
+import numpy as np
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain import evm_interp
+from cess_tpu.chain.evm import eth_address
+from cess_tpu.chain.evm_interp import asm, initcode
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.state import DispatchError
+
+D = constants.DOLLARS
+SUPPLY = 1_000_000
+
+# -- a hand-assembled ERC-20-style token ----------------------------------
+# calldata ABI (32-byte words): [method][arg1][arg2]
+#   method 1: transfer(to_word, amount)  -> LOG1(topic=to, data=amount)
+#   method 2: balanceOf(addr_word)       -> returns balance word
+# storage: slot sha3(addr_word) = balance; slot 0 = totalSupply
+
+TOKEN_RUNTIME = asm(
+    0, "CALLDATALOAD", 1, "EQ", ("push_label", "transfer"), "JUMPI",
+    0, "CALLDATALOAD", 2, "EQ", ("push_label", "balof"), "JUMPI",
+    0, 0, "REVERT",
+
+    ("label", "transfer"),
+    "CALLER", 0, "MSTORE",
+    32, 0, "SHA3",                     # [sf]
+    "DUP1", "SLOAD",                   # [sf, bf]
+    "DUP1", 64, "CALLDATALOAD",        # [sf, bf, bf, amt]
+    "SWAP1", "LT",                     # [sf, bf, bf<amt]
+    ("push_label", "fail"), "JUMPI",   # [sf, bf]
+    64, "CALLDATALOAD",                # [sf, bf, amt]
+    "SWAP1", "SUB",                    # [sf, bf-amt]
+    "SWAP1", "SSTORE",                 # debit sender
+    32, "CALLDATALOAD", 0, "MSTORE",
+    32, 0, "SHA3",                     # [st]
+    "DUP1", "SLOAD",                   # [st, bt]
+    64, "CALLDATALOAD", "ADD",         # [st, bt+amt]
+    "SWAP1", "SSTORE",                 # credit recipient
+    64, "CALLDATALOAD", 0, "MSTORE",   # data = amount
+    32, "CALLDATALOAD",                # topic = to
+    32, 0, "LOG1",
+    "STOP",
+
+    ("label", "fail"), 0, 0, "REVERT",
+
+    ("label", "balof"),
+    32, "CALLDATALOAD", 0, "MSTORE",
+    32, 0, "SHA3", "SLOAD",
+    0, "MSTORE",
+    32, 0, "RETURN",
+)
+
+# constructor: mint SUPPLY to the deployer, record totalSupply
+TOKEN_CTOR = asm(
+    "CALLER", 0, "MSTORE",
+    32, 0, "SHA3",           # [slot(caller)]
+    SUPPLY, "SWAP1", "SSTORE",
+    SUPPLY, 0, "SSTORE",
+)
+
+TOKEN_INIT = initcode(TOKEN_RUNTIME, ctor=TOKEN_CTOR)
+
+
+def word(v) -> bytes:
+    if isinstance(v, bytes):
+        return v.rjust(32, b"\0")
+    return int(v).to_bytes(32, "big")
+
+
+def calldata(method: int, *args) -> bytes:
+    return word(method) + b"".join(word(a) for a in args)
+
+
+@pytest.fixture
+def rt():
+    rt = Runtime(RuntimeConfig(era_blocks=1000))
+    for who in ("dev", "bob"):
+        rt.fund(who, 1_000 * D)
+    return rt
+
+
+def test_token_deploy_transfer_balance(rt):
+    addr = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    assert rt.evm.code_at(addr) == TOKEN_RUNTIME
+    dev_w = eth_address("dev")
+    bob_w = eth_address("bob")
+    # constructor minted to deployer
+    assert int.from_bytes(
+        rt.evm.query(addr, calldata(2, dev_w)), "big") == SUPPLY
+    # transfer 250 dev -> bob
+    rt.apply_extrinsic("dev", "evm.call", addr, calldata(1, bob_w, 250))
+    assert int.from_bytes(
+        rt.evm.query(addr, calldata(2, dev_w)), "big") == SUPPLY - 250
+    assert int.from_bytes(
+        rt.evm.query(addr, calldata(2, bob_w)), "big") == 250
+    # overdraw reverts and changes nothing
+    with pytest.raises(DispatchError, match="Reverted"):
+        rt.apply_extrinsic("bob", "evm.call", addr,
+                           calldata(1, dev_w, 9_999_999))
+    assert int.from_bytes(
+        rt.evm.query(addr, calldata(2, bob_w)), "big") == 250
+    # logs archived for eth_getLogs
+    logs = rt.evm.logs_in_range(0, rt.state.block, address=addr)
+    assert len(logs) == 1
+    assert logs[0]["topics"][0] == word(bob_w)
+    assert int.from_bytes(logs[0]["data"], "big") == 250
+
+
+def test_query_is_read_only(rt):
+    addr = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    bob_w = eth_address("bob")
+    # a transfer run through query (eth_call) must not commit
+    rt.evm.query(addr, calldata(1, bob_w, 10), caller="dev")
+    assert int.from_bytes(
+        rt.evm.query(addr, calldata(2, bob_w)), "big") == 0
+
+
+def test_infinite_loop_cannot_stall_block_production(rt):
+    looper = initcode(asm(("label", "spin"),
+                          ("push_label", "spin"), "JUMP"))
+    addr = rt.apply_extrinsic("dev", "evm.deploy", looper)
+    with pytest.raises(DispatchError, match="ExecutionFailed"):
+        rt.apply_extrinsic("dev", "evm.call", addr, b"", 100_000)
+    # dispatch failed but the chain advances: nothing is wedged
+    before = rt.state.block
+    rt.advance_blocks(2)
+    assert rt.state.block == before + 2
+
+
+def test_interp_primitives():
+    # arithmetic + memory + return
+    res = evm_interp.execute(asm(7, 5, "ADD", 0, "MSTORE", 32, 0, "RETURN"))
+    assert int.from_bytes(res.output, "big") == 12
+    # revert carries data
+    with pytest.raises(evm_interp.EvmRevert) as e:
+        evm_interp.execute(asm(0xDEAD, 0, "MSTORE", 32, 0, "REVERT"))
+    assert int.from_bytes(e.value.data, "big") == 0xDEAD
+    # jump to a non-JUMPDEST is an exceptional halt
+    with pytest.raises(evm_interp.EvmError):
+        evm_interp.execute(asm(3, "JUMP", "STOP"))
+
+
+def test_eth_rpc_surface():
+    """deploy -> eth_sendRawTransaction(transfer) -> eth_call(balanceOf)
+    -> eth_getLogs, all through the RPC server."""
+    import json
+    import urllib.request
+
+    from cess_tpu import codec
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "rpc-evm", {"alice": spec.session_key("alice")})
+    srv = RpcServer(node, port=0)
+    srv.start()
+    try:
+        port = srv.port
+
+        def rpc(method, *params):
+            req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}", data=req,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=10) as resp:
+                out = json.loads(resp.read())
+            if "error" in out:
+                raise RuntimeError(out["error"])
+            return out["result"]
+
+        rpc("author_submitExtrinsic", "alice", "evm.deploy",
+            "0x" + TOKEN_INIT.hex())
+        node.try_author(1) and node.commit_proposal()
+        addr = [k[0] for k, _ in
+                node.runtime.state.iter_prefix("evm", "code")][0]
+        assert rpc("eth_getCode", "0x" + addr.hex()) \
+            == "0x" + TOKEN_RUNTIME.hex()
+
+        # eth_sendRawTransaction: client-built, codec-encoded signed tx
+        bob_w = eth_address("bob")
+        xt = sign_extrinsic(
+            spec.account_key("alice"), node.runtime.genesis_hash(),
+            "alice", node.runtime.system.nonce("alice"),
+            "evm.call",
+            ([k[0] for k, _ in
+              node.runtime.state.iter_prefix("evm", "code")][0],
+             calldata(1, bob_w, 77)), ())
+        assert rpc("eth_sendRawTransaction",
+                   "0x" + codec.encode(xt).hex())
+        node.try_author(2) and node.commit_proposal()
+
+        got = rpc("eth_call", "0x" + addr.hex(),
+                  "0x" + calldata(2, bob_w).hex())
+        assert int(got, 16) == 77
+        logs = rpc("eth_getLogs", {"fromBlock": 0,
+                                   "address": "0x" + addr.hex()})
+        assert len(logs) == 1
+        assert int.from_bytes(codec_bytes(logs[0]["data"]), "big") == 77
+    finally:
+        srv.stop()
+
+
+def codec_bytes(v) -> bytes:
+    """RPC values arrive JSON-encoded; bytes may come back hex-coded."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str) and v.startswith("0x"):
+        return bytes.fromhex(v[2:])
+    if isinstance(v, str):
+        return bytes.fromhex(v)
+    if isinstance(v, list):
+        return bytes(v)
+    raise TypeError(type(v))

@@ -92,19 +92,32 @@ def test_old_chain_restarts_and_upgrades_in_band(tmp_path):
 
 # -- EVM boundary -------------------------------------------------------------
 
+ECHO_RUNTIME_ASM = ("CALLDATASIZE", 0, 0, "CALLDATACOPY",
+                    "CALLDATASIZE", 0, "RETURN")
+
+
+def _echo_init() -> bytes:
+    from cess_tpu.chain.evm_interp import asm, initcode
+
+    return initcode(asm(*ECHO_RUNTIME_ASM))
+
+
 def test_evm_boundary():
+    from cess_tpu.chain.evm_interp import asm, initcode
+
     rt = Runtime()
     rt.fund("dev", 1_000 * D)
     rt.apply_extrinsic("dev", "evm.deposit", 100 * D)
     assert rt.evm.balance("dev") == 100 * D
-    addr = rt.apply_extrinsic("dev", "evm.deploy", bytes([0xFE]) + b"echo")
+    addr = rt.apply_extrinsic("dev", "evm.deploy", _echo_init())
     assert rt.evm.code_at(addr) is not None
     out = rt.apply_extrinsic("dev", "evm.call", addr, b"ping")
     assert out == b"ping"
     assert rt.evm.query(addr, b"q") == b"q"
-    # real bytecode hits the typed capability refusal, not a crash
-    addr2 = rt.apply_extrinsic("dev", "evm.deploy", bytes([0x60, 0x80]))
-    with pytest.raises(DispatchError, match="NotSupported"):
+    # an INVALID opcode is an exceptional halt, not a crash
+    addr2 = rt.apply_extrinsic("dev", "evm.deploy",
+                               initcode(asm("INVALID")))
+    with pytest.raises(DispatchError, match="ExecutionFailed"):
         rt.apply_extrinsic("dev", "evm.call", addr2, b"")
     with pytest.raises(DispatchError, match="NoContract"):
         rt.apply_extrinsic("dev", "evm.call", b"\x00" * 20, b"")
@@ -173,7 +186,7 @@ def test_eth_namespace_rpc():
     net = Network([node])
     net.run_slots(2)
     node.submit_extrinsic("alice", "evm.deposit", 50 * D)
-    node.submit_extrinsic("alice", "evm.deploy", bytes([0xFE]))
+    node.submit_extrinsic("alice", "evm.deploy", _echo_init())
     net.run_slots(1)
     addr = [k[0] for k, _ in
             node.runtime.state.iter_prefix("evm", "code")][0]
@@ -191,7 +204,10 @@ def test_eth_namespace_rpc():
         assert call("eth_blockNumber") == hex(3)
         assert call("eth_chainId").startswith("0x")
         assert int(call("eth_getBalance", "alice"), 16) == 50 * D
-        assert call("eth_getCode", "0x" + addr.hex()) == "0xfe"
+        from cess_tpu.chain.evm_interp import asm
+
+        assert call("eth_getCode", "0x" + addr.hex()) \
+            == "0x" + asm(*ECHO_RUNTIME_ASM).hex()
         assert call("eth_call", "0x" + addr.hex(), "0xabcd") == "0xabcd"
         assert call("web3_clientVersion").startswith("cess-tpu")
     finally:
