@@ -1,16 +1,28 @@
-"""Governance: council motions + treasury spending + sudo retirement.
+"""Governance: collectives (Council + TechnicalCommittee), treasury,
+bounties, sudo retirement.
 
 The reference composes Substrate governance — Council/
-TechnicalCommittee collectives, Treasury with spend proposals and
-approvals, Bounties (/root/reference/runtime/src/lib.rs:1516-1521) —
-and a sudo pallet for the bootstrap phase. This module is the
-minimum viable surface with the same control flow:
+TechnicalCommittee collectives (both pallet_collective instances with
+PrimeDefaultVote, /root/reference/runtime/src/lib.rs:390-418),
+Treasury with spend proposals and approvals, Bounties
+(/root/reference/runtime/src/lib.rs:1516-1521) — and a sudo pallet for
+the bootstrap phase. This module is the minimum viable surface with
+the same control flow:
 
-- **Council**: a root-set membership; members open motions that name a
-  whitelisted governance call, vote aye/nay, and close — a strict
-  majority of the membership executes the call with COUNCIL origin.
-  (The whitelist is the analog of the collective's origin filter: the
-  council cannot dispatch arbitrary runtime calls.)
+- **Collective** (shared machinery): a root-set membership; members
+  open motions that name a whitelisted governance call, vote aye/nay,
+  and close — a strict majority of the membership executes the call
+  with the collective's origin. (The whitelist is the analog of the
+  collective's origin filter: a collective cannot dispatch arbitrary
+  runtime calls.) An optional PRIME member supplies the default vote
+  of absent members at close (Substrate PrimeDefaultVote,
+  runtime/src/lib.rs:404,417).
+- **Council**: approves/rejects treasury spends + bounties, rotates
+  its membership, retires sudo, applies runtime upgrades, cancels
+  deferred slashes.
+- **TechnicalCommittee**: the second chamber — can VETO an open
+  council motion (the analog of its democracy-cancel role), fast-track
+  runtime upgrades, and manage the TEE MRENCLAVE whitelist.
 - **Treasury**: anyone proposes a spend (bonding 5%, min 1 DOLLAR,
   the reference's ProposalBond); ONLY a council motion can approve or
   reject; approved spends pay out from the treasury account at the
@@ -26,6 +38,7 @@ from .. import constants
 from .state import DispatchError, State
 
 PALLET = "council"
+TC_PALLET = "technical_committee"
 TREASURY_PALLET = "treasury"
 TREASURY_ACCOUNT = "treasury"
 
@@ -46,85 +59,120 @@ COUNCIL_CALLS = {
     "staking.cancel_deferred_slash",
 }
 
+# the technical committee's narrower surface (ref: TC origins gate
+# democracy cancellation + technical paths, runtime/src/lib.rs:406-418)
+TC_CALLS = {
+    "council.veto_motion",
+    "system.apply_runtime_upgrade",
+    "tee_worker.update_whitelist",
+}
 
-class Council:
+
+class Collective:
+    """One pallet_collective instance: motions over a whitelisted call
+    set, strict-majority close, prime default vote."""
+
+    PALLET = PALLET
+    ALLOWED = COUNCIL_CALLS
+
     def __init__(self, state: State, runtime):
         self.state = state
         self.runtime = runtime   # dispatch target for approved motions
 
     # -- membership (root) ---------------------------------------------------
-    def set_members(self, members: tuple[str, ...]) -> None:
+    def set_members(self, members: tuple[str, ...],
+                    prime: str | None = None) -> None:
         if not isinstance(members, tuple) \
                 or not all(isinstance(m, str) for m in members) \
                 or len(set(members)) != len(members):
-            raise DispatchError("council.BadMembers")
+            raise DispatchError(f"{self.PALLET}.BadMembers")
+        if prime is not None and prime not in members:
+            raise DispatchError(f"{self.PALLET}.BadPrime")
         new = tuple(sorted(members))
-        self.state.put(PALLET, "members", new)
+        self.state.put(self.PALLET, "members", new)
+        self.state.put(self.PALLET, "prime", prime)
         # purge outgoing members' votes from open motions — stale ayes
-        # must never carry a motion the sitting council does not back
-        # (Substrate change_members_sorted does the same)
-        for (mid,), (ayes, nays) in list(self.state.iter_prefix(PALLET,
+        # must never carry a motion the sitting membership does not
+        # back (Substrate change_members_sorted does the same)
+        for (mid,), (ayes, nays) in list(self.state.iter_prefix(self.PALLET,
                                                                 "votes")):
             kept = (tuple(a for a in ayes if a in new),
                     tuple(x for x in nays if x in new))
             if kept != (ayes, nays):
-                self.state.put(PALLET, "votes", mid, kept)
-        self.state.deposit_event(PALLET, "MembersSet",
+                self.state.put(self.PALLET, "votes", mid, kept)
+        self.state.deposit_event(self.PALLET, "MembersSet",
                                  count=len(members))
 
     def members(self) -> tuple[str, ...]:
-        return self.state.get(PALLET, "members", default=())
+        return self.state.get(self.PALLET, "members", default=())
+
+    def prime(self) -> str | None:
+        return self.state.get(self.PALLET, "prime", default=None)
 
     def _require_member(self, who: str) -> None:
         if who not in self.members():
-            raise DispatchError("council.NotMember", who)
+            raise DispatchError(f"{self.PALLET}.NotMember", who)
 
     # -- motions ---------------------------------------------------------------
     def propose(self, who: str, call: str, args: tuple) -> int:
         self._require_member(who)
-        if call not in COUNCIL_CALLS:
-            raise DispatchError("council.CallNotAllowed", call)
+        if call not in self.ALLOWED:
+            raise DispatchError(f"{self.PALLET}.CallNotAllowed", call)
         if not isinstance(args, tuple):
-            raise DispatchError("council.BadArgs")
-        mid = self.state.get(PALLET, "next_motion", default=0)
-        self.state.put(PALLET, "next_motion", mid + 1)
-        self.state.put(PALLET, "motion", mid,
+            raise DispatchError(f"{self.PALLET}.BadArgs")
+        mid = self.state.get(self.PALLET, "next_motion", default=0)
+        self.state.put(self.PALLET, "next_motion", mid + 1)
+        self.state.put(self.PALLET, "motion", mid,
                        (call, args, self.state.block + MOTION_LIFE_BLOCKS))
-        self.state.put(PALLET, "votes", mid, ((who,), ()))   # ayes, nays
-        self.state.deposit_event(PALLET, "Proposed", motion=mid,
+        self.state.put(self.PALLET, "votes", mid, ((who,), ()))  # ayes, nays
+        self.state.deposit_event(self.PALLET, "Proposed", motion=mid,
                                  call=call, who=who)
         return mid
 
     def motion(self, mid: int):
-        return self.state.get(PALLET, "motion", mid)
+        return self.state.get(self.PALLET, "motion", mid)
 
     def vote(self, who: str, mid: int, approve: bool) -> None:
         self._require_member(who)
         if self.motion(mid) is None:
-            raise DispatchError("council.NoMotion", str(mid))
-        ayes, nays = self.state.get(PALLET, "votes", mid)
+            raise DispatchError(f"{self.PALLET}.NoMotion", str(mid))
+        ayes, nays = self.state.get(self.PALLET, "votes", mid)
         if who in ayes or who in nays:
-            raise DispatchError("council.AlreadyVoted", who)
+            raise DispatchError(f"{self.PALLET}.AlreadyVoted", who)
         if approve:
             ayes = tuple(sorted((*ayes, who)))
         else:
             nays = tuple(sorted((*nays, who)))
-        self.state.put(PALLET, "votes", mid, (ayes, nays))
-        self.state.deposit_event(PALLET, "Voted", motion=mid, who=who,
+        self.state.put(self.PALLET, "votes", mid, (ayes, nays))
+        self.state.deposit_event(self.PALLET, "Voted", motion=mid, who=who,
                                  approve=bool(approve))
 
     def close(self, who: str, mid: int) -> None:
         """Execute (strict majority aye), or drop (majority nay /
-        expired). Anyone may close."""
+        expired). Anyone may close. With a prime member set, absent
+        members count as voting the prime's way (PrimeDefaultVote) —
+        but ONLY once the motion's voting window has ended (Substrate
+        semantics): before the deadline a close needs enough ACTUAL
+        votes, so a prime can never propose-and-execute alone in one
+        block, denying other members (and the TC veto) their window."""
         m = self.motion(mid)
         if m is None:
-            raise DispatchError("council.NoMotion", str(mid))
+            raise DispatchError(f"{self.PALLET}.NoMotion", str(mid))
         call, args, deadline = m
-        ayes, nays = self.state.get(PALLET, "votes", mid)
-        n = len(self.members())
-        if 2 * len(ayes) > n:
-            self.state.delete(PALLET, "motion", mid)
-            self.state.delete(PALLET, "votes", mid)
+        ayes, nays = self.state.get(self.PALLET, "votes", mid)
+        members = self.members()
+        n = len(members)
+        prime = self.prime()
+        absent = sum(1 for x in members if x not in ayes and x not in nays)
+        n_ayes, n_nays = len(ayes), len(nays)
+        if prime is not None and absent and self.state.block >= deadline:
+            if prime in ayes:
+                n_ayes += absent
+            elif prime in nays:
+                n_nays += absent
+        if 2 * n_ayes > n:
+            self.state.delete(self.PALLET, "motion", mid)
+            self.state.delete(self.PALLET, "votes", mid)
             # execute in a SUB-transaction: a failing call (e.g. the
             # spend was already approved by another motion) must not
             # roll back the motion's removal and brick it open forever
@@ -134,7 +182,7 @@ class Council:
                 getattr(self.runtime.pallets[pallet_name], method)(*args)
             except DispatchError as e:
                 self.state.rollback_tx()
-                self.state.deposit_event(PALLET, "ExecutionFailed",
+                self.state.deposit_event(self.PALLET, "ExecutionFailed",
                                          motion=mid, call=call,
                                          error=e.name)
             except Exception as e:
@@ -142,18 +190,35 @@ class Council:
                 # open tx mark (that would desync block undo logs)
                 self.state.rollback_tx()
                 self.state.deposit_event(
-                    PALLET, "ExecutionFailed", motion=mid, call=call,
-                    error=f"council.BadMotionArgs:{type(e).__name__}")
+                    self.PALLET, "ExecutionFailed", motion=mid, call=call,
+                    error=f"{self.PALLET}.BadMotionArgs:{type(e).__name__}")
             else:
                 self.state.commit_tx()
-                self.state.deposit_event(PALLET, "Executed", motion=mid,
-                                         call=call)
-        elif 2 * len(nays) >= n or self.state.block > deadline:
-            self.state.delete(PALLET, "motion", mid)
-            self.state.delete(PALLET, "votes", mid)
-            self.state.deposit_event(PALLET, "Disapproved", motion=mid)
+                self.state.deposit_event(self.PALLET, "Executed",
+                                         motion=mid, call=call)
+        elif 2 * n_nays >= n or self.state.block > deadline:
+            self.state.delete(self.PALLET, "motion", mid)
+            self.state.delete(self.PALLET, "votes", mid)
+            self.state.deposit_event(self.PALLET, "Disapproved", motion=mid)
         else:
-            raise DispatchError("council.TooEarly", str(mid))
+            raise DispatchError(f"{self.PALLET}.TooEarly", str(mid))
+
+
+class Council(Collective):
+    # TC-ONLY (not in any dispatch surface or COUNCIL_CALLS; reachable
+    # only through a TechnicalCommittee motion — its democracy-cancel
+    # analog, runtime/src/lib.rs:406-418)
+    def veto_motion(self, mid: int) -> None:
+        if self.motion(mid) is None:
+            raise DispatchError("council.NoMotion", str(mid))
+        self.state.delete(PALLET, "motion", mid)
+        self.state.delete(PALLET, "votes", mid)
+        self.state.deposit_event(PALLET, "Vetoed", motion=mid)
+
+
+class TechnicalCommittee(Collective):
+    PALLET = TC_PALLET
+    ALLOWED = TC_CALLS
 
 
 class Treasury:

@@ -24,7 +24,7 @@ from .cacher import Cacher
 from .evm import Evm
 from .extrinsic import SignedExtrinsic, verify_signature
 from .file_bank import FileBank
-from .governance import Council, Treasury
+from .governance import Council, TechnicalCommittee, Treasury
 from .im_online import ImOnline
 from . import migrations
 from .offences import Offences
@@ -50,6 +50,7 @@ ROOT_ONLY = {
     "tee_worker.pin_ias_signer",
     "audit.set_keys",
     "council.set_members",
+    "technical_committee.set_members",
     "system.apply_runtime_upgrade",
 }
 
@@ -72,7 +73,10 @@ SIGNED_CALLS = {
     "staking.validate", "staking.chill", "staking.nominate",
     "im_online.heartbeat",
     "council.propose", "council.vote", "council.close",
+    "technical_committee.propose", "technical_committee.vote",
+    "technical_committee.close",
     "treasury.propose_spend", "treasury.propose_bounty",
+    "sminer.faucet",
     "evm.deposit", "evm.withdraw", "evm.deploy", "evm.call",
     "tee_worker.register", "tee_worker.exit",
     "file_bank.create_bucket", "file_bank.delete_bucket",
@@ -105,24 +109,17 @@ FEELESS = {
 }
 
 
-# Per-dispatch weights (fee units): the analog of the reference's
-# measured per-pallet weights.rs (SURVEY.md §6 "Extrinsic weights"),
-# coarsely tiered by the work a call does; unlisted calls weigh 0 and
-# pay only base + length fees.
-CALL_WEIGHTS = {
-    "file_bank.upload_declaration": 50,   # dedup scan + deal + assignment
-    "file_bank.transfer_report": 20,
-    "file_bank.upload_filler": 30,
-    "sminer.regnstk": 20,
-    "tee_worker.register": 40,            # chain + report verification
-    "storage_handler.buy_space": 10,
-    "storage_handler.expansion_space": 10,
-    "storage_handler.renewal_space": 10,
-    "staking.bond": 5, "staking.nominate": 5, "staking.validate": 5,
-    "council.close": 15,                  # may execute a motion
-    "treasury.propose_spend": 10, "treasury.propose_bounty": 10,
-    "evm.deploy": 30, "evm.call": 20,
-}
+# Per-dispatch weights: MEASURED on a real runtime by
+# tools/gen_weights.py (the analog of the reference's
+# frame-benchmarking-generated per-pallet weights.rs via
+# .maintain/frame-weight-template.hbs, SURVEY.md §6 "Extrinsic
+# weights"). Unit: one balances.transfer dispatch; scaled x10 here so
+# weight fees stay significant next to byte fees. Unlisted calls
+# weigh 0 and pay only base + length fees. Regenerate the table with
+# `python tools/gen_weights.py --write`.
+from .weights_generated import GENERATED_WEIGHTS
+
+CALL_WEIGHTS = {call: 10 * w for call, w in GENERATED_WEIGHTS.items()}
 WEIGHT_FEE = constants.TX_BYTE_FEE      # one weight unit == one byte
 
 
@@ -190,8 +187,10 @@ class Runtime:
         }
         self.treasury_pallet = Treasury(s, self.balances)
         self.council = Council(s, self)   # needs self.pallets at close()
+        self.technical_committee = TechnicalCommittee(s, self)
         self.pallets["treasury"] = self.treasury_pallet
         self.pallets["council"] = self.council
+        self.pallets["technical_committee"] = self.technical_committee
         self.evm = Evm(s, self.balances)
         self.pallets["evm"] = self.evm
         # genesis stamps the CHAIN's spec version (ChainSpec field),

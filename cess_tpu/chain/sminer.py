@@ -19,6 +19,9 @@ from .state import DispatchError, State
 
 PALLET = "sminer"
 REWARD_POOL = "sminer_reward_pool"
+FAUCET_ACCOUNT = "faucet"
+FAUCET_AMOUNT = 10_000 * constants.DOLLARS   # ref lib.rs:478 (10000 TCESS)
+FAUCET_INTERVAL = constants.ONE_DAY_BLOCKS   # ref one-day rate limit :470
 
 POSITIVE = "positive"   # in service
 FROZEN = "frozen"       # collateral below limit; replenish to recover
@@ -113,6 +116,26 @@ class Sminer:
         self.state.put(PALLET, "miner", who, m)
         self.state.deposit_event(PALLET, "CollateralIncreased",
                                  who=who, amount=amount)
+
+    def faucet(self, who: str, target: str) -> None:
+        """Dev/testnet faucet: dispense FAUCET_AMOUNT to ``target`` at
+        most once per FAUCET_INTERVAL blocks, from the genesis faucet
+        account — the reference's sminer faucet with its one-day rate
+        limit (c-pallets/sminer/src/lib.rs:460-498). Anyone may pull
+        for any target (matches the reference: the extrinsic takes a
+        destination AccountId)."""
+        if not isinstance(target, str) or not target:
+            raise DispatchError("sminer.BadFaucetTarget")
+        last = self.state.get(PALLET, "faucet_last", target, default=None)
+        now = self.state.block
+        if last is not None and now < last + FAUCET_INTERVAL:
+            raise DispatchError("sminer.FaucetUsedToday", target)
+        if self.balances.free(FAUCET_ACCOUNT) < FAUCET_AMOUNT:
+            raise DispatchError("sminer.FaucetEmpty")
+        self.balances.transfer(FAUCET_ACCOUNT, target, FAUCET_AMOUNT)
+        self.state.put(PALLET, "faucet_last", target, now)
+        self.state.deposit_event(PALLET, "FaucetDispensed", who=who,
+                                 target=target, amount=FAUCET_AMOUNT)
 
     def update_beneficiary(self, who: str, beneficiary: str) -> None:
         m = self._require(who)

@@ -164,3 +164,101 @@ def test_bounty_lifecycle(rt):
     assert rt.treasury_pallet.bounty(bid2) is None
     bond2 = 10_000 * D * PROPOSAL_BOND_PERMILL // 1000
     assert rt.balances.free("treasury") == t0 - 50_000 * D + bond2
+
+
+# -- technical committee (second chamber, ref runtime/src/lib.rs:406-418) --
+
+def tc_setup(rt):
+    for who in ("t1", "t2", "t3"):
+        rt.fund(who, 1_000_000 * D)
+    rt.apply_extrinsic("root", "technical_committee.set_members",
+                       ("t1", "t2", "t3"))
+
+
+def test_tc_veto_cancels_council_motion(rt):
+    """The TC's democracy-cancel analog: a TC majority vetoes an open
+    council motion; the vetoed motion is gone and can never execute."""
+    tc_setup(rt)
+    pid = rt.treasury_pallet.propose_spend("prop", "team", 100_000 * D)
+    mid = spend_motion(rt, "c1", pid)
+    rt.apply_extrinsic("t1", "technical_committee.propose",
+                       "council.veto_motion", (mid,))
+    tmid = rt.state.get("technical_committee", "next_motion") - 1
+    rt.apply_extrinsic("t2", "technical_committee.vote", tmid, True)
+    rt.apply_extrinsic("t3", "technical_committee.close", tmid)
+    assert rt.council.motion(mid) is None
+    ev = rt.state.events_of("council", "Vetoed")
+    assert dict(ev[-1].data)["motion"] == mid
+    # the vetoed motion cannot be voted or closed anymore
+    with pytest.raises(DispatchError, match="NoMotion"):
+        rt.apply_extrinsic("c2", "council.vote", mid, True)
+    rt.advance_blocks(ERA)
+    assert rt.balances.free("team") == 0
+
+
+def test_tc_cannot_exceed_allowed_calls(rt):
+    tc_setup(rt)
+    with pytest.raises(DispatchError, match="CallNotAllowed"):
+        rt.apply_extrinsic("t1", "technical_committee.propose",
+                           "treasury.approve_spend", (0,))
+    # and council members are not TC members
+    with pytest.raises(DispatchError, match="NotMember"):
+        rt.apply_extrinsic("c1", "technical_committee.propose",
+                           "council.veto_motion", (0,))
+
+
+def test_prime_default_vote(rt, monkeypatch):
+    """PrimeDefaultVote: absent members count as voting the prime's
+    way at close, but ONLY after the voting window ends — before the
+    deadline the prime alone cannot carry a motion
+    (ref runtime/src/lib.rs:404,417; Substrate close semantics)."""
+    from cess_tpu.chain import governance as gov
+
+    monkeypatch.setattr(gov, "MOTION_LIFE_BLOCKS", 5)
+    rt.apply_extrinsic("root", "council.set_members",
+                       ("c1", "c2", "c3"), prime="c1")
+    pid = rt.treasury_pallet.propose_spend("prop", "team", 50_000 * D)
+    mid = spend_motion(rt, "c1", pid)      # only the prime voted aye
+    # BEFORE the deadline, absent members do NOT default: too early
+    with pytest.raises(DispatchError, match="TooEarly"):
+        rt.apply_extrinsic("c2", "council.close", mid)
+    rt.advance_blocks(5)
+    # after the window, absent c2/c3 default to the prime's aye
+    rt.apply_extrinsic("c2", "council.close", mid)
+    ev = rt.state.events_of("council", "Executed")
+    assert dict(ev[-1].data)["motion"] == mid
+    # prime voting NAY defaults absentees to nay: motion drops
+    pid2 = rt.treasury_pallet.propose_spend("prop", "beta", 50_000 * D)
+    rt.apply_extrinsic("c2", "council.propose", "treasury.approve_spend",
+                       (pid2,))
+    mid2 = rt.state.get("council", "next_motion") - 1
+    rt.apply_extrinsic("c1", "council.vote", mid2, False)
+    rt.advance_blocks(6)
+    rt.apply_extrinsic("c3", "council.close", mid2)
+    ev = rt.state.events_of("council", "Disapproved")
+    assert dict(ev[-1].data)["motion"] == mid2
+
+
+# -- sminer faucet (ref c-pallets/sminer/src/lib.rs:460-498) ---------------
+
+def test_faucet_rate_limited(rt, monkeypatch):
+    from cess_tpu.chain import sminer as sminer_mod
+    from cess_tpu.chain.sminer import FAUCET_AMOUNT
+
+    # one real day is 14400 blocks; shrink the window so the test can
+    # cross it without grinding hundreds of era rotations
+    monkeypatch.setattr(sminer_mod, "FAUCET_INTERVAL", 2 * ERA)
+    rt.fund("faucet", 100_000 * D)
+    rt.fund("newbie", 1 * D)   # fee money
+    rt.apply_extrinsic("newbie", "sminer.faucet", "newbie")
+    assert rt.balances.free("newbie") >= FAUCET_AMOUNT
+    # second pull within the interval is refused
+    with pytest.raises(DispatchError, match="FaucetUsedToday"):
+        rt.apply_extrinsic("newbie", "sminer.faucet", "newbie")
+    # a different target still works
+    rt.apply_extrinsic("newbie", "sminer.faucet", "other")
+    assert rt.balances.free("other") == FAUCET_AMOUNT
+    # after the interval the same target can pull again
+    rt.advance_blocks(2 * ERA)
+    rt.apply_extrinsic("newbie", "sminer.faucet", "newbie")
+    assert rt.balances.free("newbie") >= 2 * FAUCET_AMOUNT

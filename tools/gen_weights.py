@@ -1,0 +1,212 @@
+"""Generate per-dispatch call weights by measuring real dispatch times.
+
+The reference derives per-extrinsic weights from frame-benchmarking
+runs rendered through .maintain/frame-weight-template.hbs into
+per-pallet weights.rs. This is the framework-native analog: build a
+runtime, drive each weighted call inside a representative scenario,
+time the dispatch, and emit cess_tpu/chain/weights_generated.py with
+weights normalized to balances.transfer == 1 unit.
+
+Usage: python tools/gen_weights.py [--reps 40] [--write]
+Without --write it prints the table; with --write it regenerates the
+checked-in module.
+"""
+from __future__ import annotations
+
+import argparse
+import statistics
+import time
+
+from cess_tpu import constants
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+
+D = constants.DOLLARS
+MIB = 1 << 20
+
+
+def seg_hashes(n, salt=b"s"):
+    return [(salt + bytes([i]) + b"seg" + b"\0" * 28,
+             tuple(salt + bytes([i, j]) + b"frag" + b"\0" * 26
+                   for j in range(3)))
+            for i in range(n)]
+
+
+def base_rt() -> Runtime:
+    rt = Runtime(RuntimeConfig(era_blocks=100_000))
+    rt.system.set_sudo("root_acct")
+    for a in ("alice", "bob", "root_acct", "gw", "c1", "c2", "c3"):
+        rt.fund(a, 10_000_000 * D)
+    for i in range(6):
+        w = f"m{i}"
+        rt.fund(w, 10_000 * D)
+        rt.apply_extrinsic(w, "sminer.regnstk", w, b"peer" + w.encode(),
+                           2000 * D)
+        rt.sminer.add_miner_idle_space(w, 4000 * constants.FRAGMENT_SIZE)
+    rt.apply_extrinsic("alice", "storage_handler.buy_space", 20)
+    rt.apply_extrinsic("alice", "file_bank.create_bucket", "alice", "bkt")
+    rt.apply_extrinsic("root", "council.set_members", ("c1", "c2", "c3"))
+    return rt
+
+
+def scenarios():
+    """(call, setup(rt) -> (origin, args)) per weighted dispatch.
+    Setup runs per rep (fresh id per rep keeps calls valid)."""
+    from cess_tpu.chain.evm_interp import asm, initcode
+    from cess_tpu.chain.file_bank import UserBrief
+
+    echo = initcode(asm("CALLDATASIZE", 0, 0, "CALLDATACOPY",
+                        "CALLDATASIZE", 0, "RETURN"))
+    counter = {"n": 0}
+
+    def nxt() -> int:
+        counter["n"] += 1
+        return counter["n"]
+
+    def upload(rt):
+        i = nxt()
+        fh = b"f" + i.to_bytes(4, "little") + b"\0" * 27
+        return "alice", ("file_bank.upload_declaration", fh,
+                         seg_hashes(2, salt=b"w%d" % i),
+                         UserBrief("alice", "f.txt", "bkt"), 2 * 16 * MIB)
+
+    def transfer_report(rt):
+        i = nxt()
+        fh = b"g" + i.to_bytes(4, "little") + b"\0" * 27
+        rt.apply_extrinsic("alice", "file_bank.upload_declaration", fh,
+                           seg_hashes(2, salt=b"x%d" % i),
+                           UserBrief("alice", "f.txt", "bkt"), 2 * 16 * MIB)
+        return rt.file_bank.deal(fh).assigned[0], \
+            ("file_bank.transfer_report", fh)
+
+    def regnstk(rt):
+        w = f"w{nxt()}"
+        rt.fund(w, 10_000 * D)
+        return w, ("sminer.regnstk", w, b"p", 2000 * D)
+
+    def bond(rt):
+        a = f"s{nxt()}"
+        rt.fund(a, 10_000_000 * D)
+        return a, ("staking.bond", 4_000_000 * D)
+
+    def evm_deploy(rt):
+        return "alice", ("evm.deploy", echo)
+
+    def evm_call(rt):
+        if "addr" not in counter:
+            counter["addr"] = rt.apply_extrinsic("alice", "evm.deploy",
+                                                 echo)
+        return "alice", ("evm.call", counter["addr"], b"x" * 64)
+
+    def council_close(rt):
+        pid = rt.treasury_pallet.propose_spend("alice", "team", 10 * D)
+        rt.apply_extrinsic("c1", "council.propose",
+                           "treasury.approve_spend", (pid,))
+        mid = rt.state.get("council", "next_motion") - 1
+        rt.apply_extrinsic("c2", "council.vote", mid, True)
+        return "c3", ("council.close", mid)
+
+    def buy_space(rt):
+        b = f"b{nxt()}"
+        rt.fund(b, 10_000_000 * D)
+        return b, ("storage_handler.buy_space", 2)
+
+    def oss_register(rt):
+        g = f"g{nxt()}"
+        rt.fund(g, 10 * D)
+        return g, ("oss.register", b"peer", "gw.example")
+
+    def spend(rt):
+        return "alice", ("treasury.propose_spend", "team", 10 * D)
+
+    def bounty(rt):
+        return "alice", ("treasury.propose_bounty", b"fix", 10 * D)
+
+    def validate(rt):
+        a = f"v{nxt()}"
+        rt.fund(a, 10_000_000 * D)
+        rt.apply_extrinsic(a, "staking.bond", 4_000_000 * D)
+        return a, ("staking.validate",)
+
+    def nominate(rt):
+        a = f"n{nxt()}"
+        rt.fund(a, 10_000_000 * D)
+        rt.apply_extrinsic(a, "staking.bond", 4_000_000 * D)
+        if "vtgt" not in counter:
+            rt.fund("vt", 10_000_000 * D)
+            rt.apply_extrinsic("vt", "staking.bond", 4_000_000 * D)
+            rt.apply_extrinsic("vt", "staking.validate")
+            counter["vtgt"] = True
+        return a, ("staking.nominate", "vt")
+
+    def xfer(rt):
+        return "alice", ("balances.transfer", "bob", 1 * D)
+
+    return {
+        "balances.transfer": xfer,
+        "file_bank.upload_declaration": upload,
+        "file_bank.transfer_report": transfer_report,
+        "sminer.regnstk": regnstk,
+        "storage_handler.buy_space": buy_space,
+        "staking.bond": bond,
+        "staking.validate": validate,
+        "staking.nominate": nominate,
+        "oss.register": oss_register,
+        "council.close": council_close,
+        "treasury.propose_spend": spend,
+        "treasury.propose_bounty": bounty,
+        "evm.deploy": evm_deploy,
+        "evm.call": evm_call,
+    }
+
+
+def measure(reps: int) -> dict[str, float]:
+    rt = base_rt()
+    out: dict[str, float] = {}
+    for call, setup in scenarios().items():
+        times = []
+        for _ in range(reps):
+            origin, args = setup(rt)
+            t0 = time.perf_counter()
+            rt.apply_extrinsic(origin, *args)
+            times.append(time.perf_counter() - t0)
+        out[call] = statistics.median(times) * 1e6   # us
+    return out
+
+
+HEADER = '''"""AUTO-GENERATED by tools/gen_weights.py — do not edit by hand.
+
+Per-dispatch weights measured on a real runtime (the analog of the
+reference's frame-benchmarking-generated per-pallet weights.rs via
+.maintain/frame-weight-template.hbs). Unit: one balances.transfer.
+Regenerate: python tools/gen_weights.py --write
+"""
+
+GENERATED_WEIGHTS = {
+'''
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=40)
+    ap.add_argument("--write", action="store_true")
+    args = ap.parse_args()
+    us = measure(args.reps)
+    unit = us["balances.transfer"]
+    weights = {c: max(1, round(v / unit)) for c, v in us.items()}
+    for c in sorted(weights):
+        print(f"{c:40s} {us[c]:9.1f} us  weight {weights[c]}")
+    if args.write:
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "cess_tpu", "chain", "weights_generated.py")
+        with open(path, "w") as f:
+            f.write(HEADER)
+            for c in sorted(weights):
+                f.write(f'    "{c}": {weights[c]},\n')
+            f.write("}\n")
+        print(f"wrote {os.path.normpath(path)}")
+
+
+if __name__ == "__main__":
+    main()
