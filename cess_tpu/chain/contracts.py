@@ -1,0 +1,344 @@
+"""User-programmable contracts: a gas-metered VM over codec values.
+
+The reference runs pallet-contracts (Wasm) ALONGSIDE the EVM
+(/root/reference/runtime/src/lib.rs:1191-1207, composed at :1525).
+This module is the framework-native second execution layer with the
+same role split: where the EVM boundary (cess_tpu/chain/evm.py)
+executes 256-bit-word bytecode for Ethereum-shaped tooling, the
+contracts VM executes structured programs over the framework's OWN
+canonical value model — ints (arbitrary precision), bytes, strings and
+tuples — with per-contract KV storage, host functions, and strict gas
+metering. Programs are codec-encodable tuples of instructions, so
+deploy/call arguments ride the normal extrinsic wire format.
+
+Execution model: a stack machine. Each instruction is a tuple
+``(op, *immediates)``; values on the stack are codec values. Control
+flow is absolute instruction-index jumps, checked per step. Gas is
+charged per instruction plus size-dependent costs (storage writes,
+value construction), so an infinite loop burns its gas limit and
+reverts — block production can never stall. All storage writes go
+through the transactional ``State``; a trap/out-of-gas raises
+DispatchError and the surrounding dispatch rolls back.
+
+Instruction set (stack effects in comments):
+  ("push", v)        -> v
+  ("pop",)           v ->
+  ("dup", i)         duplicate i-th from top (0 = top)
+  ("swap",)          a b -> b a
+  ("add"|"sub"|"mul"|"div"|"mod",)   a b -> (a OP b), ints only
+  ("eq"|"lt"|"gt",)  a b -> bool as 0/1
+  ("not",)           a -> 0/1
+  ("len",)           seq -> int
+  ("index",)         seq i -> seq[i]
+  ("concat",)        a b -> a + b  (bytes/str/tuple)
+  ("tuple", n)       v1..vn -> (v1, .., vn)
+  ("jump", pc)       absolute jump
+  ("jumpi", pc)      cond -> ; jump when cond truthy
+  ("input",)         -> the full call-input tuple (method, *args)
+  ("caller",)        -> calling account id (str)
+  ("sget",)          key -> storage[key] (None when absent)
+  ("sput",)          key value ->
+  ("emit",)          value -> (deposits a ContractEvent)
+  ("return",)        value -> halt, value is the call result
+  ("revert",)        value -> halt + revert with message
+"""
+from __future__ import annotations
+
+import hashlib
+
+from .state import DispatchError, State
+
+PALLET = "contracts"
+GAS_CAP = 2_000_000
+DEFAULT_GAS = 200_000
+MAX_CODE_INSTRS = 16_384
+MAX_VALUE_BYTES = 64 * 1024     # bound on constructed values
+MAX_STACK = 256
+
+G_STEP = 1
+G_SGET = 20
+G_SPUT = 200
+G_EMIT = 50
+MAX_DEPTH = 32                   # nesting bound for constructed values
+
+
+class _Trap(Exception):
+    pass
+
+
+class _Revert(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+def _size_of(v) -> int:
+    """Iterative (no Python recursion — outcome must depend on gas,
+    never interpreter stack depth) size with a hard nesting cap."""
+    total = 0
+    stack = [(v, 0)]
+    while stack:
+        x, depth = stack.pop()
+        if depth > MAX_DEPTH:
+            raise _Trap("value nesting too deep")
+        if isinstance(x, (bytes, str)):
+            total += len(x)
+        elif isinstance(x, tuple):
+            total += 1
+            stack.extend((e, depth + 1) for e in x)
+        elif isinstance(x, int) and not isinstance(x, bool):
+            total += 8 + abs(x).bit_length() // 8   # big ints cost more
+        else:
+            total += 8
+    return total
+
+
+def _exec(code: tuple, *, input_tuple: tuple, caller: str,
+          gas_limit: int, sget, sput, emit) -> object:
+    stack: list = []
+    gas = gas_limit
+    pc = 0
+
+    def use(n: int) -> None:
+        nonlocal gas
+        gas -= n
+        if gas < 0:
+            raise _Trap("out of gas")
+
+    def pop():
+        if not stack:
+            raise _Trap("stack underflow")
+        return stack.pop()
+
+    def push(v) -> None:
+        if len(stack) >= MAX_STACK:
+            raise _Trap("stack overflow")
+        stack.append(v)
+
+    def int2(op):
+        b, a = pop(), pop()
+        if not (isinstance(a, int) and isinstance(b, int)
+                and not isinstance(a, bool) and not isinstance(b, bool)):
+            raise _Trap(f"{op}: ints required")
+        return a, b
+
+    while pc < len(code):
+        ins = code[pc]
+        pc += 1
+        if not (isinstance(ins, tuple) and ins
+                and isinstance(ins[0], str)):
+            raise _Trap(f"malformed instruction at {pc - 1}")
+        op = ins[0]
+        use(G_STEP)
+        if op == "push":
+            if len(ins) != 2:
+                raise _Trap("push arity")
+            use(_size_of(ins[1]))
+            push(ins[1])
+        elif op == "pop":
+            pop()
+        elif op == "dup":
+            i = ins[1] if len(ins) > 1 else 0
+            if not isinstance(i, int) or not 0 <= i < len(stack):
+                raise _Trap("dup index")
+            push(stack[-1 - i])
+        elif op == "swap":
+            a, b = pop(), pop()
+            push(a); push(b)
+        elif op in ("add", "sub", "mul", "div", "mod"):
+            a, b = int2(op)
+            if op == "add":
+                r = a + b
+            elif op == "sub":
+                r = a - b
+            elif op == "mul":
+                use(max(a.bit_length(), b.bit_length()) // 8)
+                r = a * b
+            elif op == "div":
+                if b == 0:
+                    raise _Trap("division by zero")
+                r = a // b
+            else:
+                if b == 0:
+                    raise _Trap("division by zero")
+                r = a % b
+            if abs(r) >> (8 * MAX_VALUE_BYTES):
+                raise _Trap("integer too large")
+            push(r)
+        elif op in ("eq", "lt", "gt"):
+            b, a = pop(), pop()
+            if op == "eq":
+                push(1 if a == b else 0)
+            else:
+                if not (isinstance(a, int) and isinstance(b, int)):
+                    raise _Trap(f"{op}: ints required")
+                push(1 if ((a < b) if op == "lt" else (a > b)) else 0)
+        elif op == "not":
+            push(0 if pop() else 1)
+        elif op == "len":
+            v = pop()
+            if not isinstance(v, (bytes, str, tuple)):
+                raise _Trap("len: sequence required")
+            push(len(v))
+        elif op == "index":
+            i, v = pop(), pop()
+            if not isinstance(v, (bytes, str, tuple)) \
+                    or not isinstance(i, int) or not 0 <= i < len(v):
+                raise _Trap("index out of range")
+            push(v[i])
+        elif op == "concat":
+            b, a = pop(), pop()
+            if not (type(a) is type(b)
+                    and isinstance(a, (bytes, str, tuple))):
+                raise _Trap("concat: matching sequences required")
+            if _size_of(a) + _size_of(b) > MAX_VALUE_BYTES:
+                raise _Trap("value too large")
+            use(_size_of(a) + _size_of(b))
+            push(a + b)
+        elif op == "tuple":
+            n = ins[1] if len(ins) > 1 else 0
+            if not isinstance(n, int) or not 0 <= n <= len(stack):
+                raise _Trap("tuple arity")
+            vs = tuple(reversed([pop() for _ in range(n)]))
+            use(_size_of(vs))
+            push(vs)
+        elif op in ("jump", "jumpi"):
+            tgt = ins[1] if len(ins) > 1 else -1
+            if op == "jumpi" and not pop():
+                continue
+            if not isinstance(tgt, int) or not 0 <= tgt < len(code):
+                raise _Trap(f"bad jump target {tgt}")
+            pc = tgt
+        elif op == "input":
+            push(input_tuple)
+        elif op == "caller":
+            push(caller)
+        elif op == "sget":
+            use(G_SGET)
+            push(sget(pop()))
+        elif op == "sput":
+            v, k = pop(), pop()
+            use(G_SPUT + _size_of(v) + _size_of(k))
+            sput(k, v)
+        elif op == "emit":
+            use(G_EMIT)
+            emit(pop())
+        elif op == "return":
+            return pop()
+        elif op == "revert":
+            raise _Revert(pop())
+        else:
+            raise _Trap(f"unknown op {op!r}")
+    return None
+
+
+def _storage_key(k) -> bytes:
+    from .. import codec
+
+    return hashlib.sha256(codec.encode(k)).digest()
+
+
+class Contracts:
+    """The pallet boundary: deploy/call/query over the VM, matching
+    evm.py's surface shape (runtime/src/lib.rs:1191-1207 role)."""
+
+    def __init__(self, state: State):
+        self.state = state
+
+    def _check_gas(self, gas_limit) -> int:
+        if not isinstance(gas_limit, int) or gas_limit <= 0:
+            raise DispatchError("contracts.InvalidGas")
+        return min(gas_limit, GAS_CAP)
+
+    @staticmethod
+    def _check_code(code) -> None:
+        if not (isinstance(code, tuple) and 0 < len(code)
+                <= MAX_CODE_INSTRS
+                and all(isinstance(i, tuple) and i
+                        and isinstance(i[0], str) for i in code)):
+            raise DispatchError("contracts.InvalidCode")
+
+    def deploy(self, who: str, code: tuple) -> bytes:
+        """Store a program; constructors are an explicit follow-up
+        ``call(addr, "init", ...)`` by convention (keeps deploy cost
+        independent of program behavior, so no gas parameter).
+        Returns the address."""
+        self._check_code(code)
+        nonce = self.state.get(PALLET, "nonce", who, default=0)
+        self.state.put(PALLET, "nonce", who, nonce + 1)
+        addr = hashlib.sha256(b"cvm-create:" + who.encode()
+                              + nonce.to_bytes(8, "little")).digest()[:20]
+        self.state.put(PALLET, "code", addr, code)
+        self.state.deposit_event(PALLET, "Deployed", who=who,
+                                 address=addr, instrs=len(code))
+        return addr
+
+    def code_at(self, address: bytes):
+        return self.state.get(PALLET, "code", address)
+
+    def call(self, who: str, address: bytes, method: str,
+             args: tuple = (), gas_limit: int = DEFAULT_GAS):
+        """Execute ``method(*args)``; storage writes and events commit
+        with the surrounding dispatch transaction."""
+        if not isinstance(method, str) or not isinstance(args, tuple):
+            raise DispatchError("contracts.InvalidCall")
+        gas_limit = self._check_gas(gas_limit)
+        out = self._run(who, address, (method, *args), gas_limit)
+        self.state.deposit_event(PALLET, "Called", who=who,
+                                 address=address, method=method)
+        return out
+
+    def query(self, address: bytes, method: str, args: tuple = (),
+              caller: str = "", gas_limit: int = DEFAULT_GAS):
+        """Read-only call: storage reads from chain state, writes to a
+        throwaway overlay, no events."""
+        if not isinstance(method, str) or not isinstance(args, tuple):
+            raise DispatchError("contracts.InvalidCall")
+        gas_limit = self._check_gas(gas_limit)
+        overlay: dict[bytes, object] = {}
+        code = self.code_at(address)
+        if code is None:
+            raise DispatchError("contracts.NoContract")
+
+        def sget(k):
+            kk = _storage_key(k)
+            if kk in overlay:
+                return overlay[kk]
+            return self.state.get(PALLET, "storage", address, kk)
+
+        try:
+            return _exec(code, input_tuple=(method, *args), caller=caller,
+                         gas_limit=gas_limit, sget=sget,
+                         sput=lambda k, v: overlay.__setitem__(
+                             _storage_key(k), v),
+                         emit=lambda v: None)
+        except _Revert as e:
+            raise DispatchError("contracts.Reverted", repr(e.value)) from e
+        except _Trap as e:
+            raise DispatchError("contracts.Trapped", str(e)) from e
+
+    # -- engine bridge -------------------------------------------------------
+    def _run(self, who: str, address: bytes, input_tuple: tuple,
+             gas_limit: int):
+        code = self.code_at(address)
+        if code is None:
+            raise DispatchError("contracts.NoContract")
+
+        def sget(k):
+            return self.state.get(PALLET, "storage", address,
+                                  _storage_key(k))
+
+        def sput(k, v) -> None:
+            self.state.put(PALLET, "storage", address, _storage_key(k), v)
+
+        def emit(v) -> None:
+            self.state.deposit_event(PALLET, "ContractEvent",
+                                     address=address, data=v)
+
+        try:
+            return _exec(code, input_tuple=input_tuple, caller=who,
+                         gas_limit=gas_limit, sget=sget, sput=sput,
+                         emit=emit)
+        except _Revert as e:
+            raise DispatchError("contracts.Reverted", repr(e.value)) from e
+        except _Trap as e:
+            raise DispatchError("contracts.Trapped", str(e)) from e

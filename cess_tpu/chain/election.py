@@ -1,0 +1,160 @@
+"""Multi-phase validator election (ElectionProviderMultiPhase analog).
+
+The reference elects validators through ElectionProviderMultiPhase:
+during a signed submission window, anyone may submit a pre-computed
+election solution with a claimed score and a deposit; solutions are
+feasibility-checked on admission, the best claim wins, false claims
+are slashed, and an on-chain solver is the fallback when the phase
+closes empty (/root/reference/runtime/src/lib.rs:613,834-863). The
+solver objective here is the credit-weighted VrfSolver ranking
+(cess_tpu/node/consensus.py:elect_validators; runtime lib.rs:764-786).
+
+Flow per era:
+- the SIGNED PHASE is the last ``signed_phase_blocks`` of the era;
+  ``submit_solution(validators, claimed_score)`` reserves a deposit,
+  cheap-checks feasibility (distinct bonded validators over the stake
+  floor, within max size), and keeps only the highest claimed score;
+- at the era boundary ``resolve`` (called INSIDE block execution by
+  the runtime's era hook, so deposit moves and the queue sweep are
+  covered by the block's undo log — a reorg rewinds them) re-scores
+  the stored solution against CURRENT stakes/credits: an OVERCLAIM
+  (actual < claimed on a feasible solution) is provably false and
+  slashes the whole deposit to the treasury; a solution that merely
+  went infeasible through third-party stake churn is refunded and
+  discarded (honest submission must not be griefable); an honest
+  solution scoring at least the on-chain solver's is adopted and
+  refunded; otherwise the solver result stands (fallback). The node's
+  session-rotation hook only READS the stored result.
+
+Scoring: score(set) = sum over members of (credit * 2^40 + stake in
+DOLLARS) — an additive objective whose optimum is exactly the
+top-max_validators of the solver's (credit, stake) ranking, so the
+solver is simultaneously the fallback and the honest best response.
+"""
+from __future__ import annotations
+
+from .. import constants
+from .state import DispatchError, State
+
+PALLET = "election"
+TREASURY_ACCOUNT = "treasury"
+
+SIGNED_PHASE_BLOCKS = 10          # submission window before each era end
+SOLUTION_DEPOSIT = 100 * constants.DOLLARS
+CREDIT_WEIGHT = 1 << 40           # credit dominates stake in the score
+
+
+def score_of(validators, stakes: dict[str, int],
+             credits: dict[str, int]) -> int:
+    return sum(credits.get(v, 0) * CREDIT_WEIGHT
+               + stakes.get(v, 0) // constants.DOLLARS
+               for v in validators)
+
+
+class Election:
+    def __init__(self, state: State, balances, staking, credit,
+                 era_blocks: int,
+                 signed_phase_blocks: int = SIGNED_PHASE_BLOCKS,
+                 max_validators: int = 0):
+        self.state = state
+        self.balances = balances
+        self.staking = staking
+        self.credit = credit
+        self.era_blocks = era_blocks
+        self.signed_phase_blocks = min(signed_phase_blocks, era_blocks - 1)
+        self.max_validators = max_validators   # 0 -> caller supplies
+
+    # -- phase ----------------------------------------------------------------
+    def in_signed_phase(self) -> bool:
+        pos = self.state.block % self.era_blocks
+        return pos >= self.era_blocks - self.signed_phase_blocks
+
+    def _candidates(self) -> dict[str, int]:
+        return {v: self.staking.bonded(v)
+                for v in self.staking.validators()}
+
+    # -- dispatchable ---------------------------------------------------------
+    def submit_solution(self, who: str, validators: tuple,
+                        claimed_score: int) -> None:
+        """Signed-phase solution submission (reference's signed
+        submissions, lib.rs:834-863). Cheap feasibility on admission;
+        the full re-score happens at the era boundary where a false
+        claim costs the deposit."""
+        if not self.in_signed_phase():
+            raise DispatchError("election.NotInSignedPhase")
+        if not (isinstance(validators, tuple) and validators
+                and all(isinstance(v, str) for v in validators)
+                and len(set(validators)) == len(validators)):
+            raise DispatchError("election.MalformedSolution")
+        if self.max_validators and len(validators) > self.max_validators:
+            raise DispatchError("election.SolutionTooLarge")
+        if not isinstance(claimed_score, int) or claimed_score < 0:
+            raise DispatchError("election.MalformedSolution")
+        stakes = self._candidates()
+        for v in validators:
+            if stakes.get(v, 0) < constants.MIN_ELECTABLE_STAKE:
+                raise DispatchError("election.IneligibleCandidate", v)
+        best = self.state.get(PALLET, "best", default=None)
+        if best is not None and best[2] >= claimed_score:
+            raise DispatchError("election.WeakerThanQueued")
+        self.balances.reserve(who, SOLUTION_DEPOSIT)
+        if best is not None:
+            # replaced submitter gets their deposit back immediately
+            self.balances.unreserve(best[0], SOLUTION_DEPOSIT)
+        self.state.put(PALLET, "best",
+                       (who, tuple(validators), claimed_score))
+        self.state.deposit_event(PALLET, "SolutionQueued", who=who,
+                                 size=len(validators),
+                                 claimed_score=claimed_score)
+
+    # -- era boundary ---------------------------------------------------------
+    def resolve(self, max_validators: int) -> tuple[str, ...]:
+        """Resolve the election and store the result in state:
+        verified queued solution if it beats the on-chain solver, else
+        the solver result (fallback). MUST run inside block execution
+        (the runtime era hook) — it moves deposits and sweeps the
+        queue, which the block's undo log has to cover."""
+        from ..node.consensus import elect_validators
+
+        stakes = self._candidates()
+        credits = self.credit.credits()
+        fallback = elect_validators(stakes, credits, max_validators)
+        fb_score = score_of(fallback, stakes, credits)
+        best = self.state.get(PALLET, "best", default=None)
+        winner = fallback
+        if best is not None:
+            self.state.delete(PALLET, "best")
+            who, validators, claimed = best
+            feasible = (len(validators) <= max_validators
+                        and all(stakes.get(v, 0)
+                                >= constants.MIN_ELECTABLE_STAKE
+                                for v in validators))
+            actual = score_of(validators, stakes, credits) \
+                if feasible else -1
+            if feasible and actual < claimed:
+                # OVERCLAIM: provably false — the whole deposit goes to
+                # the treasury (the reference's defensive slash for bad
+                # signed solutions). An underclaim (stake grew since
+                # submission) and infeasibility through third-party
+                # churn are NOT the submitter's fault: refund.
+                self.balances.slash_reserved(who, SOLUTION_DEPOSIT,
+                                             TREASURY_ACCOUNT)
+                self.state.deposit_event(PALLET, "SolutionSlashed",
+                                         who=who, claimed=claimed,
+                                         actual=actual)
+            else:
+                self.balances.unreserve(who, SOLUTION_DEPOSIT)
+                if feasible and actual >= fb_score:
+                    winner = tuple(validators)
+                    self.state.deposit_event(PALLET, "SolutionElected",
+                                             who=who, score=actual)
+        if winner is fallback and fallback:
+            self.state.deposit_event(PALLET, "FallbackElected",
+                                     size=len(fallback))
+        self.state.put(PALLET, "result", winner)
+        return winner
+
+    def result(self) -> tuple[str, ...]:
+        """The last resolved authority set (what the node's session
+        rotation reads; empty before the first era boundary)."""
+        return self.state.get(PALLET, "result", default=())

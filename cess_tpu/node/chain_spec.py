@@ -85,6 +85,7 @@ class ChainSpec:
     def build_runtime(self) -> Runtime:
         rt = Runtime(RuntimeConfig(
             fragment_count=self.fragment_count, era_blocks=self.era_blocks,
+            max_validators=self.max_validators,
             audit_challenge_life=self.audit_challenge_life,
             audit_verify_life=self.audit_verify_life,
             genesis_spec_version=self.genesis_spec_version))

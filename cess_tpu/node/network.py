@@ -31,7 +31,7 @@ from .. import codec
 from ..chain.extrinsic import SignedExtrinsic, sign_extrinsic
 from ..chain.state import DispatchError
 from .chain_spec import ChainSpec
-from .consensus import Rrsc, SlotClaim, elect_validators
+from .consensus import Rrsc, SlotClaim
 from .finality import FinalityGadget, Justification
 
 
@@ -380,14 +380,16 @@ class Node:
                 agent.on_block(self)
 
     def _maybe_rotate_session(self) -> None:
-        """Era boundary: credit-weighted election refreshes the
-        authority set (reference §3.5)."""
+        """Era boundary: READ the multi-phase election result that the
+        runtime's era hook resolved inside block execution (verified
+        signed solution if one beat the solver, else the on-chain
+        credit-weighted fallback) and refresh the authority set
+        (reference §3.5; runtime/src/lib.rs:613,834-863). Resolution
+        itself lives in the runtime so deposits/queue sweeps are
+        covered by the block undo log."""
         if self.runtime.state.block % self.spec.era_blocks:
             return
-        stakes = {v: self.runtime.staking.bonded(v)
-                  for v in self.runtime.staking.validators()}
-        credits = self.runtime.credit.credits()
-        elected = elect_validators(stakes, credits, self.spec.max_validators)
+        elected = self.runtime.election.result()
         if elected:
             self.authorities = elected
 

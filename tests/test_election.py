@@ -1,0 +1,134 @@
+"""Multi-phase election: signed solutions, false-claim slashing,
+on-chain fallback (VERDICT r3 Missing #4 done-criteria; reference
+ElectionProviderMultiPhase, runtime/src/lib.rs:613,834-863)."""
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain import election as el
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.state import DispatchError
+
+D = constants.DOLLARS
+ERA = 30
+MAXV = 3
+
+
+@pytest.fixture
+def rt():
+    rt = Runtime(RuntimeConfig(era_blocks=ERA))
+    for i in range(4):
+        v = f"v{i}"
+        rt.fund(v, 10_000_000 * D)
+        rt.apply_extrinsic(v, "staking.bond", (4_000_000 + i) * D)
+        rt.apply_extrinsic(v, "staking.validate")
+    rt.fund("solver", 1_000_000 * D)
+    rt.fund("griefer", 1_000_000 * D)
+    return rt
+
+
+def goto_signed_phase(rt):
+    target = ERA - el.SIGNED_PHASE_BLOCKS + 1
+    rt.run_to_block(target)
+    assert rt.election.in_signed_phase()
+
+
+def honest(rt, validators):
+    stakes = {v: rt.staking.bonded(v) for v in rt.staking.validators()}
+    return el.score_of(validators, stakes, rt.credit.credits())
+
+
+def test_fallback_on_empty_phase(rt):
+    winner = rt.election.resolve(MAXV)
+    # solver ranking: equal credits, stake tie-break -> v3, v2, v1
+    assert winner == ("v3", "v2", "v1")
+    ev = rt.state.events_of("election", "FallbackElected")
+    assert ev, "fallback must be announced"
+
+
+def test_honest_solution_adopted_with_refund(rt):
+    goto_signed_phase(rt)
+    sol = ("v3", "v2", "v1")
+    rt.apply_extrinsic("solver", "election.submit_solution", sol,
+                       honest(rt, sol))
+    assert rt.balances.reserved("solver") == el.SOLUTION_DEPOSIT
+    winner = rt.election.resolve(MAXV)
+    assert winner == sol
+    assert rt.balances.reserved("solver") == 0   # deposit refunded
+    ev = rt.state.events_of("election", "SolutionElected")
+    assert dict(ev[-1].data)["who"] == "solver"
+
+
+def test_false_claim_slashed_and_fallback_engages(rt):
+    goto_signed_phase(rt)
+    sol = ("v0",)   # feasible but weak solution...
+    lie = honest(rt, ("v3", "v2", "v1")) + 12345   # ...claimed unbeatable
+    rt.apply_extrinsic("griefer", "election.submit_solution", sol, lie)
+    t0 = rt.balances.free("treasury")
+    winner = rt.election.resolve(MAXV)
+    assert winner == ("v3", "v2", "v1")           # fallback engaged
+    assert rt.balances.reserved("griefer") == 0
+    assert rt.balances.free("treasury") == t0 + el.SOLUTION_DEPOSIT
+    ev = rt.state.events_of("election", "SolutionSlashed")
+    assert dict(ev[-1].data)["who"] == "griefer"
+
+
+def test_submission_gates(rt):
+    # outside the signed phase
+    with pytest.raises(DispatchError, match="NotInSignedPhase"):
+        rt.apply_extrinsic("solver", "election.submit_solution",
+                           ("v1",), 1)
+    goto_signed_phase(rt)
+    # non-validator / under stake floor candidates are refused on admission
+    with pytest.raises(DispatchError, match="IneligibleCandidate"):
+        rt.apply_extrinsic("solver", "election.submit_solution",
+                           ("nobody",), 1)
+    with pytest.raises(DispatchError, match="MalformedSolution"):
+        rt.apply_extrinsic("solver", "election.submit_solution",
+                           ("v1", "v1"), 1)
+
+
+def test_weaker_submission_rejected_and_replacement_refunds(rt):
+    goto_signed_phase(rt)
+    good = honest(rt, ("v3", "v2", "v1"))
+    rt.apply_extrinsic("solver", "election.submit_solution",
+                       ("v2", "v1"), honest(rt, ("v2", "v1")))
+    # a weaker claim cannot displace the queued one
+    with pytest.raises(DispatchError, match="WeakerThanQueued"):
+        rt.apply_extrinsic("griefer", "election.submit_solution",
+                           ("v1",), honest(rt, ("v1",)))
+    # a stronger claim replaces it and the old deposit is returned
+    rt.apply_extrinsic("griefer", "election.submit_solution",
+                       ("v3", "v2", "v1"), good)
+    assert rt.balances.reserved("solver") == 0
+    assert rt.balances.reserved("griefer") == el.SOLUTION_DEPOSIT
+    assert rt.election.resolve(MAXV) == ("v3", "v2", "v1")
+
+
+def test_node_rotation_consumes_election(rt_unused=None):
+    """End-to-end: a solution submitted over the node path becomes the
+    authority set at the era boundary."""
+    from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis
+    from cess_tpu.node.network import Network, Node
+
+    spec = ChainSpec(
+        name="t", chain_id="mpe",
+        endowed=(("alice", 1_000_000_000 * D),),
+        validators=tuple(ValidatorGenesis(f"v{i}", 4_000_000 * D)
+                         for i in range(3)),
+        era_blocks=12, epoch_blocks=12, sudo="alice",
+        max_validators=2)
+    node = Node(spec, "n0",
+                {f"v{i}": spec.session_key(f"v{i}") for i in range(3)})
+    net = Network([node])
+    net.run_slots(1)
+    rt = node.runtime
+    # drive to the signed phase, then submit a 2-seat solution
+    while not rt.election.in_signed_phase():
+        net.run_slots(1)
+    stakes = {v: rt.staking.bonded(v) for v in rt.staking.validators()}
+    sol = tuple(sorted(stakes, key=lambda v: -stakes[v])[:2])
+    node.submit_extrinsic("alice", "election.submit_solution", sol,
+                          el.score_of(sol, stakes, rt.credit.credits()))
+    while rt.state.block % spec.era_blocks or rt.state.block == 0:
+        net.run_slots(1)
+    assert node.authorities == sol

@@ -62,6 +62,36 @@ def test_sharded_proof_matches_single_device(setup):
     assert np.asarray(ok).all()
 
 
+def test_protocol_geometry_sharded_pipeline():
+    """The sharded pipeline at REAL protocol shapes (VERDICT r3 #5):
+    16 MiB segments, 8 MiB fragments (FRAGMENT_COUNT=3 geometry, i.e.
+    RS(2,1), ref primitives/common/src/lib.rs:60-62 +
+    runtime/src/lib.rs:1026-1027), sectors=256, 16384 PoDR2 blocks per
+    fragment — where per-device memory/layout bugs live that toy
+    shapes cannot reach. 2 segments over a (2, 4) device mesh."""
+    from cess_tpu import constants
+
+    cfg = PipelineConfig(k=2, m=1,
+                         segment_size=constants.SEGMENT_SIZE)
+    assert cfg.fragment_size == constants.FRAGMENT_SIZE          # 8 MiB
+    assert cfg.blocks_per_fragment == 16384
+    pipe = StoragePipeline(cfg)
+    mesh = make_mesh(jax.devices()[:8], seg=2, byte=4)
+    step = sharded_pipeline_step(pipe, mesh)
+    b, rows = 2, cfg.k + cfg.m
+    data = np.random.default_rng(3).integers(
+        0, 256, (b, cfg.k, cfg.fragment_size), dtype=np.uint8)
+    ids = np.arange(b * rows, dtype=np.int32).reshape(b, rows)
+    idx, nu = podr2.gen_challenge(b"protocol-geometry-round",
+                                  cfg.blocks_per_fragment)
+    shards, tags, ok = step(jnp.asarray(data), jnp.asarray(ids), idx, nu)
+    assert shards.shape == (b, rows, cfg.fragment_size)
+    assert tags.shape == (b, rows, cfg.blocks_per_fragment, podr2.LIMBS)
+    assert np.asarray(ok).all(), "protocol-geometry audit failed"
+    # systematic rows ARE the data (hash identity is a chain invariant)
+    np.testing.assert_array_equal(np.asarray(shards[:, :cfg.k]), data)
+
+
 def test_multihost_corpus_run_single_process():
     """The multi-host corpus path (global mesh + host-local ingest via
     make_array_from_process_local_data + streamed batches) on the
