@@ -67,11 +67,14 @@ class AttestationReport:
         return REPORT_SIGNING_CONTEXT + codec.encode(self)
 
 
-def report_data_binding(podr2_pk: bytes, controller: str) -> bytes:
+def report_data_binding(podr2_pk: bytes, controller: str,
+                        bls_pk: bytes = b"") -> bytes:
     """What an honest enclave puts in report_data: binds the PoDR2 key
-    AND the registering controller, so neither can be swapped."""
+    AND the registering controller (and the BLS verdict-signing master
+    key when the worker carries one), so none can be swapped."""
+    extra = b"|bls:" + bls_pk if bls_pk else b""
     return hashlib.sha256(REPORT_DATA_CONTEXT + podr2_pk + b"|"
-                          + controller.encode()).digest()
+                          + controller.encode() + extra).digest()
 
 
 def _check_shape(report: AttestationReport,
@@ -138,10 +141,10 @@ def issue_cert(parent_keypair, subject: str, pubkey: RsaPublicKey,
 
 def issue_report(signer_keypair, mrenclave: bytes, podr2_pk: bytes,
                  controller: str, mr_signer: bytes = b"\x05" * 32,
-                 timestamp: int = ATTESTATION_TIME
+                 timestamp: int = ATTESTATION_TIME, bls_pk: bytes = b""
                  ) -> tuple[AttestationReport, bytes]:
     report = AttestationReport(
         mrenclave=mrenclave, mr_signer=mr_signer,
-        report_data=report_data_binding(podr2_pk, controller),
+        report_data=report_data_binding(podr2_pk, controller, bls_pk),
         timestamp=timestamp)
     return report, signer_keypair.sign_pkcs1v15(report.signing_payload())

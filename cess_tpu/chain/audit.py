@@ -21,13 +21,15 @@ import dataclasses
 import hashlib
 
 from .. import codec, constants
-from ..crypto import ed25519
+from ..crypto import bls12381, ed25519
 from .sminer import Sminer
 from .state import DispatchError, State
 
 PALLET = "audit"
 
 SESSION_SIGNING_CONTEXT = b"cess-tpu/audit-proposal-v1:"
+VERDICT_SIGNING_CONTEXT = b"cess-tpu/tee-verdict-v1:"
+VERDICT_LOG_MAX = 1024         # bounded public verdict log
 
 CHALLENGE_LIFE_BASE = 300      # blocks; + per-miner extension like the ref
 CHALLENGE_LIFE_PER_MINER = 1
@@ -77,6 +79,43 @@ class ProveInfo:
     snapshot: MinerSnapshot
     idle_proof: bytes
     service_proof: bytes
+
+
+@codec.register
+@dataclasses.dataclass(frozen=True)
+class VerdictRecord:
+    """A TEE verdict sealed for THIRD-PARTY re-verification: anyone
+    holding the worker's on-chain 96-byte BLS pubkey can recheck
+    bls12381.verify(bls_pk, verdict_message(...), bls_sig) without any
+    TEE secret — the public-verifiability property the reference gets
+    from enclave_verify::verify_bls
+    (primitives/enclave-verify/src/lib.rs:230-235)."""
+    tee: str
+    miner: str
+    mission_digest: bytes       # sha256 of the codec-encoded ProveInfo
+    idle_ok: bool
+    service_ok: bool
+    bls_sig: bytes              # 48-byte G1 signature ("" = legacy worker)
+
+
+def verdict_message(tee: str, mission_digest: bytes, idle_ok: bool,
+                    service_ok: bool) -> bytes:
+    """The exact bytes a TEE master key signs for one verify result."""
+    return (VERDICT_SIGNING_CONTEXT
+            + codec.encode((tee, mission_digest, idle_ok, service_ok)))
+
+
+def mission_digest(mission: ProveInfo) -> bytes:
+    return hashlib.sha256(codec.encode(mission)).digest()
+
+
+def reverify_verdict(record: VerdictRecord, bls_pk: bytes) -> bool:
+    """Public re-verification of a stored verdict — pure function of
+    on-chain data, no secrets."""
+    return bls12381.verify(
+        bls_pk, verdict_message(record.tee, record.mission_digest,
+                                record.idle_ok, record.service_ok),
+        record.bls_sig)
 
 
 class Audit:
@@ -203,6 +242,10 @@ class Audit:
             self.state.deposit_event(PALLET, "ChallengeStart", start=now,
                                      miners=len(miners))
 
+    def verdicts(self) -> tuple[VerdictRecord, ...]:
+        """The bounded public log of BLS-sealed TEE verdicts."""
+        return self.state.get(PALLET, "verdicts", default=())
+
     def challenge(self) -> ChallengeInfo | None:
         return self.state.get(PALLET, "challenge")
 
@@ -250,11 +293,27 @@ class Audit:
 
     # -- verification results (lib.rs:484-545) ---------------------------------
     def submit_verify_result(self, tee: str, miner: str, idle_ok: bool,
-                             service_ok: bool) -> None:
+                             service_ok: bool, bls_sig: bytes = b"") -> None:
         missions = self.state.get(PALLET, "unverify", tee, default=())
         mission = next((p for p in missions if p.miner == miner), None)
         if mission is None:
             raise DispatchError("audit.NonExistentMission")
+        worker = self.tee_worker.worker(tee) if self.tee_worker else None
+        if worker is not None and worker.bls_pk:
+            # a worker that registered a BLS master key MUST seal every
+            # verdict; the chain checks the pairing so the sealed record
+            # below is verifiable by anyone from on-chain data alone
+            digest = mission_digest(mission)
+            if not bls12381.verify(
+                    worker.bls_pk,
+                    verdict_message(tee, digest, idle_ok, service_ok),
+                    bls_sig):
+                raise DispatchError("audit.BadVerdictSignature")
+            log = self.state.get(PALLET, "verdicts", default=())
+            log += (VerdictRecord(tee=tee, miner=miner,
+                                  mission_digest=digest, idle_ok=idle_ok,
+                                  service_ok=service_ok, bls_sig=bls_sig),)
+            self.state.put(PALLET, "verdicts", log[-VERDICT_LOG_MAX:])
         rest = tuple(p for p in missions if p.miner != miner)
         if rest:
             self.state.put(PALLET, "unverify", tee, rest)

@@ -132,6 +132,8 @@ def _exec(code: tuple, *, input_tuple: tuple, caller: str,
         if op == "push":
             if len(ins) != 2:
                 raise _Trap("push arity")
+            if _size_of(ins[1]) > MAX_VALUE_BYTES:
+                raise _Trap("value too large")
             use(_size_of(ins[1]))
             push(ins[1])
         elif op == "pop":
@@ -199,6 +201,8 @@ def _exec(code: tuple, *, input_tuple: tuple, caller: str,
             if not isinstance(n, int) or not 0 <= n <= len(stack):
                 raise _Trap("tuple arity")
             vs = tuple(reversed([pop() for _ in range(n)]))
+            if _size_of(vs) > MAX_VALUE_BYTES:
+                raise _Trap("value too large")
             use(_size_of(vs))
             push(vs)
         elif op in ("jump", "jumpi"):
@@ -213,15 +217,21 @@ def _exec(code: tuple, *, input_tuple: tuple, caller: str,
         elif op == "caller":
             push(caller)
         elif op == "sget":
-            use(G_SGET)
-            push(sget(pop()))
+            v = sget(pop())
+            # loaded bytes cost gas like constructed bytes do, so a
+            # cheap loop can't stream unbounded state through the VM
+            use(G_SGET + _size_of(v))
+            push(v)
         elif op == "sput":
             v, k = pop(), pop()
+            if _size_of(v) > MAX_VALUE_BYTES:
+                raise _Trap("value too large")
             use(G_SPUT + _size_of(v) + _size_of(k))
             sput(k, v)
         elif op == "emit":
-            use(G_EMIT)
-            emit(pop())
+            v = pop()
+            use(G_EMIT + _size_of(v))
+            emit(v)
         elif op == "return":
             return pop()
         elif op == "revert":
@@ -295,9 +305,6 @@ class Contracts:
             raise DispatchError("contracts.InvalidCall")
         gas_limit = self._check_gas(gas_limit)
         overlay: dict[bytes, object] = {}
-        code = self.code_at(address)
-        if code is None:
-            raise DispatchError("contracts.NoContract")
 
         def sget(k):
             kk = _storage_key(k)
@@ -305,34 +312,32 @@ class Contracts:
                 return overlay[kk]
             return self.state.get(PALLET, "storage", address, kk)
 
-        try:
-            return _exec(code, input_tuple=(method, *args), caller=caller,
-                         gas_limit=gas_limit, sget=sget,
+        return self._run(caller, address, (method, *args), gas_limit,
+                         sget=sget,
                          sput=lambda k, v: overlay.__setitem__(
                              _storage_key(k), v),
                          emit=lambda v: None)
-        except _Revert as e:
-            raise DispatchError("contracts.Reverted", repr(e.value)) from e
-        except _Trap as e:
-            raise DispatchError("contracts.Trapped", str(e)) from e
 
     # -- engine bridge -------------------------------------------------------
     def _run(self, who: str, address: bytes, input_tuple: tuple,
-             gas_limit: int):
+             gas_limit: int, sget=None, sput=None, emit=None):
+        """One exec bridge for call and query; query passes
+        overlay-backed storage hooks and a null emit."""
         code = self.code_at(address)
         if code is None:
             raise DispatchError("contracts.NoContract")
-
-        def sget(k):
-            return self.state.get(PALLET, "storage", address,
-                                  _storage_key(k))
-
-        def sput(k, v) -> None:
-            self.state.put(PALLET, "storage", address, _storage_key(k), v)
-
-        def emit(v) -> None:
-            self.state.deposit_event(PALLET, "ContractEvent",
-                                     address=address, data=v)
+        if sget is None:
+            def sget(k):
+                return self.state.get(PALLET, "storage", address,
+                                      _storage_key(k))
+        if sput is None:
+            def sput(k, v) -> None:
+                self.state.put(PALLET, "storage", address,
+                               _storage_key(k), v)
+        if emit is None:
+            def emit(v) -> None:
+                self.state.deposit_event(PALLET, "ContractEvent",
+                                         address=address, data=v)
 
         try:
             return _exec(code, input_tuple=input_tuple, caller=who,

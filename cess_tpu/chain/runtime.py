@@ -128,13 +128,17 @@ from .weights_generated import GENERATED_WEIGHTS
 # silently drop to weight 0 and become spammable.
 HAND_WEIGHTS = {
     "tee_worker.register": 40,            # chain + report verification
+    "audit.submit_verify_result": 50,     # BLS pairing check per verdict
     "file_bank.upload_filler": 30,
     "storage_handler.expansion_space": 10,
     "storage_handler.renewal_space": 10,
     "contracts.call": 20, "contracts.deploy": 20,
 }
 CALL_WEIGHTS = {call: 10 * w for call, w in GENERATED_WEIGHTS.items()}
-CALL_WEIGHTS.update(HAND_WEIGHTS)
+for _call, _floor in HAND_WEIGHTS.items():
+    # floors, not overrides: a future measured weight above the hand
+    # value must win, or heavy dispatches get silently undercharged
+    CALL_WEIGHTS[_call] = max(CALL_WEIGHTS.get(_call, 0), _floor)
 WEIGHT_FEE = constants.TX_BYTE_FEE      # one weight unit == one byte
 
 

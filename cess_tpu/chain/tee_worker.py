@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from ..crypto import bls12381
 from ..crypto.rsa import RsaPublicKey
 from .. import codec
 from .attestation import (AttestationReport, SignerCert,
@@ -38,6 +39,11 @@ class TeeWorkerInfo:
     stash: str
     peer_id: bytes
     podr2_pk: bytes
+    # BLS12-381 G2 master pubkey (96B) for publicly verifiable verdict
+    # signatures; empty for workers registered before the capability
+    # (the reference's enclave_verify::verify_bls key material,
+    # primitives/enclave-verify/src/lib.rs:230-235).
+    bls_pk: bytes = b""
 
 
 class TeeWorker:
@@ -66,7 +72,8 @@ class TeeWorker:
     def register(self, controller: str, stash: str, peer_id: bytes,
                  podr2_pk: bytes, report: AttestationReport,
                  report_sig: bytes,
-                 cert_chain: tuple[SignerCert, ...]) -> None:
+                 cert_chain: tuple[SignerCert, ...],
+                 bls_pk: bytes = b"", bls_pop: bytes = b"") -> None:
         if self.state.contains(PALLET, "worker", controller):
             raise DispatchError("tee_worker.Registered")
         roots = self.state.get(PALLET, "ias_pins", default=())
@@ -75,13 +82,23 @@ class TeeWorker:
         if report.mrenclave not in wl:   # parsed field, exact match
             raise DispatchError("tee_worker.NonTeeWorker",
                                 "MRENCLAVE not whitelisted")
-        if report.report_data != report_data_binding(podr2_pk, controller):
+        if report.report_data != report_data_binding(podr2_pk, controller,
+                                                     bls_pk):
             raise DispatchError("tee_worker.VerifyCertFailed",
                                 "report_data does not bind podr2_pk"
                                 " + controller")
+        if bls_pk:
+            # the verdict-signing master key must come with a proof of
+            # possession (rogue-key discipline for later aggregation)
+            if not (isinstance(bls_pk, bytes)
+                    and len(bls_pk) == bls12381.PK_BYTES
+                    and isinstance(bls_pop, bytes)
+                    and bls12381.verify_possession(bls_pk, bls_pop)):
+                raise DispatchError("tee_worker.BadBlsKey",
+                                    "invalid BLS pk or possession proof")
         self.state.put(PALLET, "worker", controller, TeeWorkerInfo(
             controller=controller, stash=stash, peer_id=peer_id,
-            podr2_pk=podr2_pk))
+            podr2_pk=podr2_pk, bls_pk=bls_pk))
         # network PoDR2 key = first registered worker's (lib.rs:122-123)
         if not self.state.contains(PALLET, "podr2_pk"):
             self.state.put(PALLET, "podr2_pk", podr2_pk)

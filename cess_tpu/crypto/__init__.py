@@ -8,3 +8,4 @@ TPU; a C++ fast path can slot in behind the same functions.
 """
 from .rsa import rsa_verify_pkcs1v15, RsaPublicKey  # noqa: F401
 from .hashing import sha256, blake2b_256  # noqa: F401
+from .bls12381 import verify as verify_bls  # noqa: F401

@@ -33,6 +33,7 @@ import numpy as np
 from .. import codec, constants
 from ..chain.file_bank import UserBrief
 from ..chain.state import DispatchError
+from ..crypto import bls12381
 from ..crypto.hashing import fragment_hash
 from ..models.pipeline import PipelineConfig, StoragePipeline
 from ..ops import pfield as pf
@@ -341,13 +342,26 @@ class TeeAgent:
     proofs on device."""
 
     def __init__(self, node: Node, controller: str, key: podr2.Podr2Key,
-                 blocks_per_fragment: int):
+                 blocks_per_fragment: int, bls_seed: bytes | None = None):
         self.node = node
         self.controller = controller
         self.key = key
         self.blocks = blocks_per_fragment
         self.account_key = node.spec.account_key(controller)
         self._submitted: set[tuple[str, int]] = set()
+        # BLS verdict master key: registered on chain (with a PoP) so
+        # every submit_verify_result is publicly re-verifiable
+        if bls_seed is not None:
+            self.bls_sk, self.bls_pk = bls12381.keygen(bls_seed)
+        else:
+            self.bls_sk, self.bls_pk = None, b""
+
+    def bls_registration(self) -> tuple[bytes, bytes]:
+        """(bls_pk, proof-of-possession) for tee_worker.register."""
+        if self.bls_sk is None:
+            return b"", b""
+        return self.bls_pk, bls12381.prove_possession(self.bls_sk,
+                                                      self.bls_pk)
 
     # -- filler certification -------------------------------------------------
     def certify_fillers(self, miner: str, indices: list[int],
@@ -424,9 +438,17 @@ class TeeAgent:
             idle_ok = self._verify(mission.idle_proof, list(snap.fillers),
                                    seed, idx, nu)
             self._submitted.add((mission.miner, ch.start))
+            bls_sig = b""
+            if self.bls_sk is not None:
+                from ..chain import audit as audit_mod
+                bls_sig = bls12381.sign(
+                    self.bls_sk, audit_mod.verdict_message(
+                        self.controller, audit_mod.mission_digest(mission),
+                        idle_ok, service_ok))
             node.submit_extrinsic(self.controller,
                                   "audit.submit_verify_result",
-                                  mission.miner, idle_ok, service_ok)
+                                  mission.miner, idle_ok, service_ok,
+                                  bls_sig)
 
     def _verify(self, blob, owed: list[bytes], seed: bytes,
                 idx, nu) -> bool:

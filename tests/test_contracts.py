@@ -119,3 +119,44 @@ def test_nesting_bomb_traps_deterministically(rt):
     addr = rt.apply_extrinsic("dev", "contracts.deploy", bomb)
     with pytest.raises(DispatchError, match="Trapped"):
         rt.apply_extrinsic("dev", "contracts.call", addr, "x")
+
+
+def test_oversized_values_trap_everywhere(rt):
+    """MAX_VALUE_BYTES is a real invariant: push, tuple, and sput all
+    refuse values above the cap (review finding: only concat did)."""
+    from cess_tpu.chain.contracts import MAX_VALUE_BYTES
+    big = b"\xee" * (MAX_VALUE_BYTES + 1)
+    addr = rt.apply_extrinsic("dev", "contracts.deploy",
+                              (("push", big), ("return",)))
+    with pytest.raises(DispatchError, match="Trapped"):
+        rt.apply_extrinsic("dev", "contracts.call", addr, "x",
+                           (), 10_000_000)
+    # a tuple assembled JUST under the cap from per-element pushes
+    # still traps when the aggregate crosses it
+    half = b"\xdd" * (MAX_VALUE_BYTES // 2 + 50)
+    code = (("push", half), ("push", half), ("tuple", 2), ("return",))
+    addr2 = rt.apply_extrinsic("dev", "contracts.deploy", code)
+    with pytest.raises(DispatchError, match="Trapped"):
+        rt.apply_extrinsic("dev", "contracts.call", addr2, "x",
+                           (), 10_000_000)
+
+
+def test_emit_flood_is_gas_bounded(rt):
+    """Event bytes cost gas linearly: a dup+emit loop over a large
+    value exhausts gas after a handful of events instead of flooding
+    every replica (review finding: emit charged flat gas)."""
+    from cess_tpu.chain.contracts import GAS_CAP, MAX_VALUE_BYTES
+    payload = b"\xaa" * (MAX_VALUE_BYTES - 100)
+    flood = (
+        ("push", payload),         # 0
+        ("dup", 0),                # 1
+        ("emit",),                 # 2
+        ("jump", 1),               # 3
+    )
+    addr = rt.apply_extrinsic("dev", "contracts.deploy", flood)
+    with pytest.raises(DispatchError, match="Trapped"):
+        rt.apply_extrinsic("dev", "contracts.call", addr, "x", (), GAS_CAP)
+    events = [e for e in rt.state.events
+              if e.name == "ContractEvent"]
+    emitted = sum(len(dict(e.data)["data"]) for e in events)
+    assert emitted <= GAS_CAP, "event bytes must be bounded by gas spent"

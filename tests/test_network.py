@@ -181,9 +181,16 @@ def storage_net():
         n.runtime.apply_extrinsic("root", "tee_worker.update_whitelist", mr)
         n.runtime.apply_extrinsic("root", "tee_worker.pin_ias_signer", kp.public)
     cert = issue_cert(kp, "ias-signer", signer_kp.public)
-    report, rsig = issue_report(signer_kp, mr, b"tee-pk", "tee1")
+    # the TEE registers a BLS master key, so every verify verdict in
+    # this network is sealed + publicly re-verifiable (tests the full
+    # sign -> gossip -> on-chain pairing check path under replay)
+    from cess_tpu.crypto import bls12381
+    tee_bls_sk, tee_bls_pk = bls12381.keygen(b"net-tee-master")
+    report, rsig = issue_report(signer_kp, mr, b"tee-pk", "tee1",
+                                bls_pk=tee_bls_pk)
     node.submit_extrinsic("tee1", "tee_worker.register", "stash1", b"tp",
-                          b"tee-pk", report, rsig, (cert,))
+                          b"tee-pk", report, rsig, (cert,), tee_bls_pk,
+                          bls12381.prove_possession(tee_bls_sk, tee_bls_pk))
     for w in ("m1", "m2", "m3", "m4"):
         node.submit_extrinsic(w, "sminer.regnstk", w, b"p" + w.encode(),
                               2000 * D)
@@ -192,7 +199,8 @@ def storage_net():
     gw = OssGateway(node, "gw", pipe)
     miners = [MinerAgent(node, w, [gw], pipe)
               for w in ("m1", "m2", "m3", "m4")]
-    tee = TeeAgent(node, "tee1", key, cfg.blocks_per_fragment)
+    tee = TeeAgent(node, "tee1", key, cfg.blocks_per_fragment,
+                   bls_seed=b"net-tee-master")
     # TEE-certified fillers: 400 x 8 MiB protocol units = 12.5 GiB idle
     for m in miners:
         m.setup_fillers(tee, 400)
@@ -248,6 +256,14 @@ def test_audit_round_over_network(storage_net):
     assert all(dict(e.data)["idle"] and dict(e.data)["service"]
                for e in results), "honest miners must pass"
     assert rt.state.events_of("sminer", "RewardPaid")
+    # every verdict was BLS-sealed on chain and re-verifies publicly
+    # on a DIFFERENT replica from on-chain data alone
+    from cess_tpu.chain.audit import reverify_verdict
+    other = net.nodes[1].runtime
+    recs = other.audit.verdicts()
+    assert len(recs) >= len(results)
+    bls_pk = other.tee_worker.worker("tee1").bls_pk
+    assert reverify_verdict(recs[0], bls_pk)
     # replicas still in lockstep after the full audit machinery
     assert all(n.runtime.state.state_root()
                == net.nodes[0].runtime.state.state_root()
