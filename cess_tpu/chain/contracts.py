@@ -132,9 +132,10 @@ def _exec(code: tuple, *, input_tuple: tuple, caller: str,
         if op == "push":
             if len(ins) != 2:
                 raise _Trap("push arity")
-            if _size_of(ins[1]) > MAX_VALUE_BYTES:
+            sz = _size_of(ins[1])
+            if sz > MAX_VALUE_BYTES:
                 raise _Trap("value too large")
-            use(_size_of(ins[1]))
+            use(sz)
             push(ins[1])
         elif op == "pop":
             pop()
@@ -201,9 +202,10 @@ def _exec(code: tuple, *, input_tuple: tuple, caller: str,
             if not isinstance(n, int) or not 0 <= n <= len(stack):
                 raise _Trap("tuple arity")
             vs = tuple(reversed([pop() for _ in range(n)]))
-            if _size_of(vs) > MAX_VALUE_BYTES:
+            sz = _size_of(vs)
+            if sz > MAX_VALUE_BYTES:
                 raise _Trap("value too large")
-            use(_size_of(vs))
+            use(sz)
             push(vs)
         elif op in ("jump", "jumpi"):
             tgt = ins[1] if len(ins) > 1 else -1

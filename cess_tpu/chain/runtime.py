@@ -104,7 +104,11 @@ DISPATCHABLE = SIGNED_CALLS | ROOT_ONLY
 FEELESS = {
     "audit.save_challenge_info",
     "audit.submit_proof",
-    "audit.submit_verify_result",
+    # NOT submit_verify_result: the reference dispatches it
+    # ensure_signed and fee-paying (audit/src/lib.rs:484-491), and the
+    # on-chain BLS pairing check makes it the single most expensive
+    # dispatch — a feeless failure path would let a compromised TEE
+    # burn every replica's CPU for free (fees stick on failed calls)
     # evidence-carrying, self-validating (ref submits equivocation
     # reports as validated unsigned transactions)
     "offences.report_equivocation",

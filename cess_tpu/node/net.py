@@ -40,10 +40,19 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
                                    same connection
   ("peers", (port, ...))           peer exchange (discovery): each side
                                    shares its known listen ports; the
-                                   ring-successor rule picks which of
-                                   them get dialed — the reference's
-                                   Kademlia authority-discovery role
-                                   (service.rs:508-537), flood-simple
+                                   ring-successor rule picks which get
+                                   dialed
+  ("contact", Contact)             DHT bootstrap: advertises this
+                                   node's (gossip_port, dht_port) to
+                                   seed routing tables
+
+Authority discovery is STRUCTURED (cess_tpu/node/dht.py): a Kademlia
+DHT on a second OS-assigned port answers single-shot find_node /
+find_value / store RPCs; validators periodically publish
+session-key-signed address records keyed by authority id, and
+``discover_authority`` resolves any authority in O(log n) routed
+lookups without flooding — the reference's authority-discovery worker
+over libp2p Kademlia (service.rs:508-537).
 """
 from __future__ import annotations
 
@@ -56,6 +65,8 @@ import time
 
 from .. import codec
 from ..chain.state import DispatchError
+from ..crypto import ed25519
+from . import dht as dht_mod
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -206,6 +217,13 @@ class NodeService:
         self._warp_tries = 0
         self._warp_backoff = 0.0
         self._listener: socket.socket | None = None
+        # authority discovery: Kademlia DHT on a second, OS-assigned
+        # port (service.rs:508-537 role); wired up in start()
+        self.dht_port = 0
+        self.kad: dht_mod.Kademlia | None = None
+        self._dht_listener: socket.socket | None = None
+        self._publish_serial = 0
+        self._next_publish = 0.0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -214,6 +232,18 @@ class NodeService:
         srv.bind((self.host, self.port))
         srv.listen(16)
         self._listener = srv
+        # DHT RPC listener: OS-assigned port, advertised via the
+        # "contact" frame and inside signed authority records
+        dsrv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        dsrv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        dsrv.bind((self.host, 0))
+        dsrv.listen(16)
+        self._dht_listener = dsrv
+        self.dht_port = dsrv.getsockname()[1]
+        self.kad = dht_mod.Kademlia(
+            dht_mod.Contact(port=self.port, dht_port=self.dht_port),
+            self._verify_record)
+        self._spawn(self._dht_accept_loop, dsrv)
         self._spawn(self._accept_loop, srv)
         self._redial()
         self._spawn(self._author_loop)
@@ -266,17 +296,22 @@ class NodeService:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        for srv in (self._listener, self._dht_listener):
+            if srv is not None:
+                try:
+                    srv.close()
+                except OSError:
+                    pass
         for c in list(self.conns):
             c.close()
         for t in self._threads:
             t.join(timeout=2.0)
 
     def _spawn(self, fn, *args) -> None:
+        # prune finished threads (per-request DHT handlers and publish
+        # cycles spawn continually; the join list must stay bounded)
+        if len(self._threads) > 64:
+            self._threads = [t for t in self._threads if t.is_alive()]
         t = threading.Thread(target=fn, args=args, daemon=True)
         t.start()
         self._threads.append(t)
@@ -346,6 +381,8 @@ class NodeService:
             with self.lock:
                 known = (self.port, *sorted(self._known_peers))
             self._send(conn, ("peers", known))
+            if self.kad is not None:
+                self._send(conn, ("contact", self.kad.self_contact))
             self._recv_loop(conn)   # blocks until closed
             if conn in self.conns:
                 self.conns.remove(conn)
@@ -444,6 +481,17 @@ class NodeService:
         elif kind == "peers":
             if isinstance(payload, tuple):
                 self._discover(payload)
+        elif kind == "contact":
+            # DHT bootstrap: gossip neighbors seed each other's routing
+            # tables; one reciprocal reply, then the tables grow through
+            # lookups (Kademlia's implicit maintenance)
+            if self.kad is not None \
+                    and isinstance(payload, dht_mod.Contact) \
+                    and payload.port != self.port:
+                self.kad.note(payload)
+                if not getattr(conn, "contact_sent", False):
+                    conn.contact_sent = True
+                    self._send(conn, ("contact", self.kad.self_contact))
         elif kind == "status":
             peer_head, _, peer_fin = payload
             now = time.time()
@@ -604,6 +652,169 @@ class NodeService:
             # periodic re-dial sweep: expired coolings rejoin the ring,
             # ring changes from discovery get their dial loops
             self._redial()
+            # periodic authority-record publication, off this thread
+            # (publication does blocking DHT RPCs; authoring must not)
+            now = time.time()
+            if now >= self._next_publish \
+                    and not getattr(self, "_publishing", False):
+                self._next_publish = now + 10 * self.slot_time
+                self._publishing = True
+                self._spawn(self._publish_once)
+
+    # -- authority discovery (Kademlia; service.rs:508-537 role) -------------
+    def _verify_record(self, rec: "dht_mod.AuthorityRecord") -> bool:
+        """A record is valid iff its authority is in the CURRENT
+        authority set and the signature verifies against that
+        authority's on-chain session key — the registry finality votes
+        already trust."""
+        if not (isinstance(rec.authority, str)
+                and isinstance(rec.signature, bytes)
+                and isinstance(rec.port, int) and 0 < rec.port < 65536
+                and isinstance(rec.dht_port, int)
+                and 0 < rec.dht_port < 65536
+                and isinstance(rec.serial, int) and rec.serial >= 0):
+            return False
+        with self.lock:
+            if rec.authority not in self.node.authorities:
+                return False
+            pub = self.node.runtime.state.get("system", "session_key",
+                                              rec.authority)
+        if pub is None:
+            return False
+        return ed25519.verify(pub, rec.signing_payload(), rec.signature)
+
+    def _dht_accept_loop(self, srv: socket.socket) -> None:
+        """One short-lived request/response exchange per connection —
+        DHT RPCs never occupy gossip inbound slots."""
+        while not self._stop.is_set():
+            try:
+                sock, _ = srv.accept()
+            except OSError:
+                return
+            self._spawn(self._dht_serve_one, sock)
+
+    def _dht_serve_one(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(2.0)
+            raw = _read_frame(sock)
+            if raw is None or self.kad is None:
+                return
+            resp = self.kad.handle(codec.decode(raw))
+            raw_out = codec.encode(resp)
+            sock.sendall(_LEN.pack(len(raw_out)) + raw_out)
+        except (OSError, codec.CodecError, ValueError, TypeError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _dht_call(self, contact: "dht_mod.Contact", req,
+                  timeout: float = 1.0):
+        """Client half of one DHT RPC; None on any failure."""
+        try:
+            with socket.create_connection((self.host, contact.dht_port),
+                                          timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                raw = codec.encode(req)
+                sock.sendall(_LEN.pack(len(raw)) + raw)
+                resp = _read_frame(sock)
+            return None if resp is None else codec.decode(resp)
+        except (OSError, codec.CodecError, ValueError, TypeError):
+            return None
+
+    def _iter_lookup(self, key: bytes, want_value: bool):
+        """Iterative Kademlia lookup: query the ALPHA closest unqueried
+        contacts per round, absorb returned contacts, stop when no
+        round improves. Returns (record | None, closest_contacts)."""
+        kad = self.kad
+        shortlist = {c.port: c for c in kad.closest(key)}
+        queried: set[int] = set()
+        op = "find_value" if want_value else "find_node"
+        # Kademlia termination: stop only once every still-unqueried
+        # shortlist contact has been asked (bounded by MAX_QUERIED, not
+        # by a no-new-contacts heuristic — a round that adds nothing
+        # may still leave the record-holder unqueried)
+        MAX_QUERIED = 4 * dht_mod.K
+        while len(queried) < MAX_QUERIED and not self._stop.is_set():
+            cands = sorted(
+                (c for c in shortlist.values() if c.port not in queried),
+                key=lambda c: dht_mod.distance(c.node_id(), key))
+            cands = cands[:dht_mod.ALPHA]
+            if not cands:
+                break
+            for c in cands:
+                if self._stop.is_set():
+                    break
+                queried.add(c.port)
+                resp = self._dht_call(c, (op, kad.self_contact, key))
+                if not (isinstance(resp, tuple) and len(resp) == 2):
+                    continue
+                kad.note(c)
+                if resp[0] == "value" and want_value:
+                    if kad.store_record(resp[1]):   # verifies
+                        return resp[1], list(shortlist.values())
+                    continue                        # forged: keep looking
+                if resp[0] == "nodes" and isinstance(resp[1], tuple):
+                    for n in resp[1][:2 * dht_mod.K]:
+                        if isinstance(n, dht_mod.Contact) \
+                                and n.port != self.port \
+                                and n.port not in shortlist:
+                            shortlist[n.port] = n
+                            kad.note(n)
+        closest = sorted(shortlist.values(),
+                         key=lambda c: dht_mod.distance(c.node_id(), key))
+        return None, closest[:dht_mod.K]
+
+    def _publish_once(self) -> None:
+        try:
+            self.publish_authorities()
+        finally:
+            self._publishing = False
+
+    def publish_authorities(self) -> None:
+        """Publish a signed address record for every authority whose
+        session key this node operates, to the K closest nodes (the
+        reference's authority-discovery publish half)."""
+        if self.kad is None:
+            return
+        with self.lock:
+            serial = self._publish_serial = max(self._publish_serial + 1,
+                                                int(time.time()))
+            mine = [a for a in self.node.keystore
+                    if a in self.node.authorities]
+        for account in mine:
+            # sign with the key the node actually HOLDS (finality signs
+            # with keystore values too): the on-chain registry peers
+            # verify against can rotate away from the dev-spec
+            # derivation, and a spec-derived signature would then fail
+            # _verify_record on every peer
+            rec = dht_mod.sign_record(self.node.keystore[account],
+                                      account, self.port, self.dht_port,
+                                      serial)
+            self.kad.store_record(rec)          # serve it ourselves too
+            _, closest = self._iter_lookup(dht_mod.record_key(account),
+                                           want_value=False)
+            for c in closest[:dht_mod.K]:
+                if self._stop.is_set():
+                    return
+                self._dht_call(c, ("store", self.kad.self_contact, rec))
+
+    def discover_authority(self, authority: str
+                           ) -> "dht_mod.AuthorityRecord | None":
+        """Resolve an authority's address through the DHT (verified
+        record or None); a hit also feeds the gossip ring's peer set."""
+        if self.kad is None:
+            return None
+        key = dht_mod.record_key(authority)
+        rec = self.kad.record(key)
+        if rec is None:
+            rec, _ = self._iter_lookup(key, want_value=True)
+        if rec is not None:
+            self.kad.note(rec.contact())
+            self._discover([rec.port])
+        return rec
 
     # -- client surface ------------------------------------------------------
     def submit(self, xt) -> None:

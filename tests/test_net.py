@@ -292,3 +292,65 @@ def test_warp_sync_over_tcp():
     # (a full replay would have body #1; warp + tail sync starts from
     # the checkpoint head)
     assert late[3] > 1, f"late node replayed instead of warping: {results}"
+
+
+def _dht_worker(idx, ports, q, duration, genesis_time, n):
+    """Chain bootstrap (node i initially knows only node i-1): node 0's
+    authority record must reach the FAR end of the chain through
+    structured DHT lookups, not via a direct connection."""
+    from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis
+    from cess_tpu.node.net import NodeService
+    from cess_tpu.node.network import Node
+
+    n_validators = 3
+    spec = ChainSpec(
+        name="t", chain_id="tcp-dht",
+        endowed=(("alice", 1_000_000_000 * D),),
+        validators=tuple(ValidatorGenesis(f"v{i}", 4_000_000 * D)
+                         for i in range(n_validators)),
+        era_blocks=1000, epoch_blocks=1000, sudo="alice")
+    keys = {f"v{idx}": spec.session_key(f"v{idx}")} \
+        if idx < n_validators else {}
+    node = Node(spec, f"n{idx}", keys)
+    peers = [ports[idx - 1]] if idx > 0 else []
+    svc = NodeService(node, ports[idx], peers, slot_time=0.75,
+                      genesis_time=genesis_time, degree=4)
+    svc.start()
+    deadline = time.time() + duration
+    rec = None
+    while time.time() < deadline:
+        # the LAST node keeps trying to resolve v0 (run by node 0,
+        # the far end of the bootstrap chain) through the DHT
+        if idx == n - 1 and rec is None:
+            rec = svc.discover_authority("v0")
+        time.sleep(0.5)
+    svc.stop()
+    q.put((idx, None if rec is None else (rec.authority, rec.port),
+           len(svc.kad.contacts())))
+
+
+def test_dht_authority_discovery_across_chain():
+    """6 processes bootstrapped as a chain: the tail node resolves the
+    head node's validator address via signed DHT records (the
+    authority-discovery role, service.rs:508-537). The record must
+    name v0's actual gossip port — proof it came from v0's signed
+    publication, not from local guessing."""
+    n = 6
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(n)
+    q = ctx.Queue()
+    genesis_time = time.time() + 2.0
+    procs = [ctx.Process(target=_dht_worker,
+                         args=(i, ports, q, 16.0, genesis_time, n))
+             for i in range(n)]
+    for p in procs:
+        p.start()
+    results = sorted(q.get(timeout=90) for _ in range(n))
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    tail = results[n - 1]
+    assert tail[1] == ("v0", ports[0]), \
+        f"tail node failed to discover v0: {results}"
+    # routing tables grew past the bootstrap neighbor via lookups
+    assert tail[2] >= 2
