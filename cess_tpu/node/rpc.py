@@ -14,6 +14,10 @@ Methods:
   author_submitSignedExtrinsic [hex codec-encoded SignedExtrinsic]
   system_accountNextIndex [account]
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
+  eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
+  (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
+  eth_getFilterLogs / eth_uninstallFilter) — polling filters with
+  exactly-once delivery (ref node/src/rpc.rs:229-328)
 """
 from __future__ import annotations
 
@@ -83,6 +87,10 @@ class RpcServer:
         # mutating node/runtime state (cli loop, NodeService): RPC
         # reads iterate live dicts and would otherwise race
         self.lock = lock if lock is not None else threading.Lock()
+        # Eth filter table (EthFilter namespace): id -> {type,
+        # criteria, cursor}; bounded at MAX_FILTERS
+        self._filters: dict[str, dict] = {}
+        self._filter_seq = 0
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -280,39 +288,24 @@ class RpcServer:
         if method == "eth_getLogs":
             flt = params[0] if params and isinstance(params[0], dict) \
                 else {}
-
-            def blocknum(v, default):
-                # standard Eth block tags + hex strings + plain ints
-                if v is None or v in ("latest", "pending"):
-                    return default
-                if v == "earliest":
-                    return 0
-                return int(v, 16) if isinstance(v, str) else int(v)
-
-            frm = blocknum(flt.get("fromBlock"), 0)
-            # clamp: an attacker-chosen huge toBlock must not spin the
-            # range loop while holding the node lock
-            to = min(blocknum(flt.get("toBlock"), rt.state.block),
-                     rt.state.block)
-            addr = flt.get("address")
-            addr = _decode(addr) if isinstance(addr, str) else None
-            logs = rt.evm.logs_in_range(frm, to, address=addr)
-            want_topics = flt.get("topics")
-            if want_topics:
-                def tmatch(lg):
-                    lt = lg["topics"]
-                    for i, want in enumerate(want_topics):
-                        if want is None:
-                            continue   # wildcard position
-                        opts = want if isinstance(want, list) else [want]
-                        opts = [_decode(o) if isinstance(o, str) else o
-                                for o in opts]
-                        if i >= len(lt) or lt[i] not in opts:
-                            return False
-                    return True
-
-                logs = [lg for lg in logs if tmatch(lg)]
-            return logs
+            return self._eth_logs(rt, flt)
+        if method == "eth_newFilter":
+            flt = params[0] if params and isinstance(params[0], dict) \
+                else {}
+            return self._new_filter("log", flt)
+        if method == "eth_newBlockFilter":
+            return self._new_filter("block", {})
+        if method == "eth_getFilterChanges":
+            return self._filter_changes(node, rt, params)
+        if method == "eth_getFilterLogs":
+            f = self._get_filter(params)
+            if f["type"] != "log":
+                raise RpcError(INVALID_PARAMS, "not a log filter")
+            return self._eth_logs(rt, f["criteria"])
+        if method == "eth_uninstallFilter":
+            if not params or not isinstance(params[0], str):
+                raise RpcError(INVALID_PARAMS, "expected [filter id]")
+            return self._filters.pop(params[0], None) is not None
         if method == "eth_getTransactionCount":
             if not params or not isinstance(params[0], str):
                 raise RpcError(INVALID_PARAMS, "expected [account]")
@@ -324,3 +317,112 @@ class RpcServer:
             slot = int(slot, 16) if isinstance(slot, str) else int(slot)
             return hex(rt.evm.storage_at(_decode(params[0]), slot))
         raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")
+
+    # -- Eth filters (the EthFilter namespace, node/src/rpc.rs:229-328) ----
+    @staticmethod
+    def _blocknum(v, default):
+        # standard Eth block tags + hex strings + plain ints
+        if v is None or v in ("latest", "pending"):
+            return default
+        if v == "earliest":
+            return 0
+        return int(v, 16) if isinstance(v, str) else int(v)
+
+    def _eth_logs(self, rt, flt, frm=None):
+        """Shared by eth_getLogs / eth_getFilterLogs / filter polling."""
+        if frm is None:
+            frm = self._blocknum(flt.get("fromBlock"), 0)
+        # clamp: an attacker-chosen huge toBlock must not spin the
+        # range loop while holding the node lock
+        to = min(self._blocknum(flt.get("toBlock"), rt.state.block),
+                 rt.state.block)
+        addr = flt.get("address")
+        addrs = None
+        if isinstance(addr, str):
+            addrs = {_decode(addr)}
+        elif isinstance(addr, list):     # arrays are valid per the spec
+            addrs = {_decode(a) if isinstance(a, str) else a
+                     for a in addr}
+        logs = rt.evm.logs_in_range(frm, to)
+        if addrs is not None:
+            logs = [lg for lg in logs if lg["address"] in addrs]
+        want_topics = flt.get("topics")
+        if want_topics:
+            def tmatch(lg):
+                lt = lg["topics"]
+                for i, want in enumerate(want_topics):
+                    if want is None:
+                        continue   # wildcard position
+                    opts = want if isinstance(want, list) else [want]
+                    opts = [_decode(o) if isinstance(o, str) else o
+                            for o in opts]
+                    if i >= len(lt) or lt[i] not in opts:
+                        return False
+                return True
+
+            logs = [lg for lg in logs if tmatch(lg)]
+        return logs
+
+    MAX_FILTERS = 256
+    FILTER_IDLE_TTL = 300.0    # unpolled filters are evictable (s)
+
+    def _new_filter(self, kind: str, criteria: dict) -> str:
+        import time as _time
+
+        now = _time.time()
+        if len(self._filters) >= self.MAX_FILTERS:
+            # evict idle filters first (the reference's EthFilter pool
+            # expires them); only a table full of LIVE filters errors
+            for fid in [fid for fid, f in self._filters.items()
+                        if now - f["touched"] > self.FILTER_IDLE_TTL]:
+                del self._filters[fid]
+            if len(self._filters) >= self.MAX_FILTERS:
+                raise RpcError(SERVER_ERROR, "filter table full")
+        if kind == "log":
+            # validate criteria at creation, where the spec reports
+            # errors — not on every later poll
+            try:
+                self._eth_logs(self.node.runtime, criteria,
+                               frm=self.node.head().number + 1)
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS,
+                               f"bad filter criteria: {e}") from e
+        head = self.node.head()           # handle() runs under the lock
+        self._filter_seq += 1
+        fid = hex(self._filter_seq)
+        self._filters[fid] = {"type": kind, "criteria": criteria,
+                              "cursor": head.number,
+                              "cursor_hash": head.hash(),
+                              "touched": now}
+        return fid
+
+    def _get_filter(self, params) -> dict:
+        import time as _time
+
+        if not params or not isinstance(params[0], str) \
+                or params[0] not in self._filters:
+            raise RpcError(INVALID_PARAMS, "unknown filter id")
+        f = self._filters[params[0]]
+        f["touched"] = _time.time()
+        return f
+
+    def _filter_changes(self, node, rt, params):
+        """New matches since the last poll. Exactly-once on a stable
+        chain; across a reorg the cursor rewinds to the finalized
+        block (reorgs never cross finality) so events on the new
+        canonical branch are redelivered rather than silently lost —
+        at-least-once, never at-most-once."""
+        f = self._get_filter(params)
+        head = node.head()
+        since = f["cursor"]
+        if since > head.number \
+                or node.chain[since].hash() != f["cursor_hash"]:
+            since = min(node.finalized, head.number)
+        if f["type"] == "block":
+            out = ["0x" + node.chain[n].hash().hex()
+                   for n in range(since + 1, head.number + 1)]
+        else:
+            out = self._eth_logs(rt, f["criteria"], frm=since + 1)
+        # commit the cursor only after a successful read
+        f["cursor"], f["cursor_hash"] = head.number, head.hash()
+        return out

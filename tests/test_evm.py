@@ -205,6 +205,32 @@ def test_eth_rpc_surface():
                                    "address": "0x" + addr.hex()})
         assert len(logs) == 1
         assert int.from_bytes(codec_bytes(logs[0]["data"]), "big") == 77
+
+        # EthFilter namespace (ref node/src/rpc.rs:229-328): polling
+        # filters deliver each event exactly once
+        fid = rpc("eth_newFilter", {"address": "0x" + addr.hex()})
+        bfid = rpc("eth_newBlockFilter")
+        assert rpc("eth_getFilterChanges", fid) == []   # nothing yet
+        assert len(rpc("eth_getFilterLogs", fid)) == 1  # full history
+        xt2 = sign_extrinsic(
+            spec.account_key("alice"), node.runtime.genesis_hash(),
+            "alice", node.runtime.system.nonce("alice"),
+            "evm.call", (addr, calldata(1, bob_w, 5)), ())
+        rpc("eth_sendRawTransaction", "0x" + codec.encode(xt2).hex())
+        node.try_author(3) and node.commit_proposal()
+        changes = rpc("eth_getFilterChanges", fid)
+        assert len(changes) == 1
+        assert int.from_bytes(codec_bytes(changes[0]["data"]), "big") == 5
+        assert rpc("eth_getFilterChanges", fid) == []   # exactly once
+        blocks = rpc("eth_getFilterChanges", bfid)
+        assert blocks == ["0x" + node.head().hash().hex()]
+        assert rpc("eth_uninstallFilter", fid) is True
+        assert rpc("eth_uninstallFilter", fid) is False
+        try:
+            rpc("eth_getFilterChanges", fid)
+            raise AssertionError("uninstalled filter still answered")
+        except RuntimeError:
+            pass
     finally:
         srv.stop()
 
@@ -220,3 +246,58 @@ def codec_bytes(v) -> bytes:
     if isinstance(v, list):
         return bytes(v)
     raise TypeError(type(v))
+
+
+def test_eth_filter_hardening():
+    """Review findings: address arrays honored, bad criteria rejected
+    at creation, idle filters evicted at the cap, reorg-safe cursors
+    rewind to finality instead of dropping events."""
+    import pytest
+
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcError, RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "flt", {"alice": spec.session_key("alice")})
+    srv = RpcServer(node, port=0)   # handle() used directly, no HTTP
+
+    node.submit_extrinsic("alice", "evm.deploy", TOKEN_INIT)
+    node.try_author(1) and node.commit_proposal()
+    addr = [k[0] for k, _ in
+            node.runtime.state.iter_prefix("evm", "code")][0]
+
+    # malformed criteria fail at eth_newFilter, not at poll time
+    with pytest.raises(RpcError, match="bad filter criteria"):
+        srv.handle("eth_newFilter", [{"toBlock": "0xzz"}])
+    with pytest.raises(RpcError, match="bad filter criteria"):
+        srv.handle("eth_newFilter", [{"address": "0xnothex"}])
+
+    # address ARRAYS select exactly the named contracts
+    fid = srv.handle("eth_newFilter",
+                     [{"address": ["0x" + addr.hex(),
+                                   "0x" + (b"\x99" * 20).hex()]}])
+    node.submit_extrinsic(
+        "alice", "evm.call", addr, calldata(1, eth_address("bob"), 9))
+    node.try_author(2) and node.commit_proposal()
+    assert len(srv.handle("eth_getFilterChanges", [fid])) == 1
+    miss = srv.handle("eth_newFilter",
+                      [{"address": ["0x" + (b"\x99" * 20).hex()]}])
+    assert srv.handle("eth_getFilterLogs", [miss]) == []
+
+    # cap + idle eviction: stale filters make room, live ones do not
+    for _ in range(srv.MAX_FILTERS - len(srv._filters)):
+        srv.handle("eth_newBlockFilter", [])
+    with pytest.raises(RpcError, match="filter table full"):
+        srv.handle("eth_newBlockFilter", [])
+    for f in [f for k, f in srv._filters.items()
+              if k not in (fid, miss)][:10]:
+        f["touched"] -= srv.FILTER_IDLE_TTL + 1
+    assert srv.handle("eth_newBlockFilter", [])   # evicted 10, added 1
+
+    # reorg safety: a cursor pointing at a vanished block rewinds to
+    # finality and redelivers instead of silently skipping
+    f = srv._filters[fid]
+    f["cursor"], f["cursor_hash"] = 2, b"\x00" * 32   # simulate reorg
+    redelivered = srv.handle("eth_getFilterChanges", [fid])
+    assert len(redelivered) == 1                      # block-2 log again
