@@ -667,6 +667,17 @@ def g2_decompress(data: bytes, subgroup_check: bool = True):
     return pt
 
 
+# --- native dispatch --------------------------------------------------
+# The C++ backend (native/bls381.cpp via bls_native.py) mirrors this
+# module construction-for-construction: byte-identical signatures,
+# agreeing verifies (differentially tested). Absent toolchain or
+# CESS_TPU_NO_NATIVE_BLS=1 falls back to the pure-Python path here.
+try:
+    from . import bls_native as _native
+except ImportError:
+    _native = None
+
+
 # --- signatures (min-sig: sig in G1, pk in G2) -----------------------
 def keygen(seed: bytes) -> tuple[int, bytes]:
     """Derive (sk, pk_bytes) from a seed; sk in [1, r)."""
@@ -675,10 +686,14 @@ def keygen(seed: bytes) -> tuple[int, bytes]:
     while sk == 0:
         sk = int.from_bytes(hmac.new(salt, seed, hashlib.sha512).digest(), "big") % R
         salt = hashlib.sha256(salt).digest()
+    if _native is not None:
+        return sk, _native.pk_from_sk(sk.to_bytes(32, "big"))
     return sk, g2_compress(_g2_mul(G2_GEN, sk))
 
 
 def sign(sk: int, msg: bytes, dst: bytes = DST_G1) -> bytes:
+    if _native is not None:
+        return _native.sign(sk.to_bytes(32, "big"), msg, dst)
     return g1_compress(_g1_mul(hash_to_g1(msg, dst), sk))
 
 
@@ -688,6 +703,10 @@ _NEG_G2_GEN = _g2_neg(G2_GEN)
 def verify(pk_bytes: bytes, msg: bytes, sig_bytes: bytes,
            dst: bytes = DST_G1) -> bool:
     """e(sig, -G2) * e(H(msg), pk) == 1."""
+    if not isinstance(pk_bytes, bytes) or not isinstance(sig_bytes, bytes):
+        return False
+    if _native is not None:
+        return _native.verify(pk_bytes, msg, sig_bytes, dst)
     try:
         pk = g2_decompress(pk_bytes)
         sig = g1_decompress(sig_bytes)
@@ -700,6 +719,8 @@ def verify(pk_bytes: bytes, msg: bytes, sig_bytes: bytes,
 
 def aggregate(sig_list: list[bytes]) -> bytes:
     """Sum of G1 signatures."""
+    if _native is not None:
+        return _native.aggregate(list(sig_list))
     acc = None
     for s in sig_list:
         acc = _g1_add(acc, g1_decompress(s))
@@ -714,6 +735,9 @@ def aggregate_verify(pk_msg_pairs: list[tuple[bytes, bytes]],
     msgs = [m for _, m in pk_msg_pairs]
     if len(set(msgs)) != len(msgs):
         return False
+    if _native is not None and isinstance(agg_sig, bytes) \
+            and all(isinstance(pk, bytes) for pk, _ in pk_msg_pairs):
+        return _native.aggregate_verify(list(pk_msg_pairs), agg_sig, dst)
     try:
         sig = g1_decompress(agg_sig)
         pairs = [(sig, _NEG_G2_GEN)]

@@ -288,7 +288,12 @@ class RpcServer:
         if method == "eth_getLogs":
             flt = params[0] if params and isinstance(params[0], dict) \
                 else {}
-            return self._eth_logs(rt, flt)
+            try:
+                crit = self._norm_criteria(flt)
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS,
+                               f"bad filter criteria: {e}") from e
+            return self._eth_logs(rt, crit)
         if method == "eth_newFilter":
             flt = params[0] if params and isinstance(params[0], dict) \
                 else {}
@@ -328,34 +333,58 @@ class RpcServer:
             return 0
         return int(v, 16) if isinstance(v, str) else int(v)
 
-    def _eth_logs(self, rt, flt, frm=None):
-        """Shared by eth_getLogs / eth_getFilterLogs / filter polling."""
-        if frm is None:
-            frm = self._blocknum(flt.get("fromBlock"), 0)
+    def _norm_criteria(self, flt: dict) -> dict:
+        """Decode + validate filter criteria ONCE (at eth_newFilter /
+        per eth_getLogs call, where the spec reports errors) — polls
+        then work with pre-decoded values. Raises ValueError/TypeError
+        on malformed input."""
+        crit = {"frm": self._blocknum(flt.get("fromBlock"), 0),
+                "to": flt.get("toBlock")}
+        self._blocknum(crit["to"], 0)           # parse-check now
+        addr = flt.get("address")
+        if isinstance(addr, str):
+            crit["addrs"] = frozenset({_decode(addr)})
+        elif isinstance(addr, list):            # arrays are valid per spec
+            crit["addrs"] = frozenset(
+                _decode(a) if isinstance(a, str) else bytes(a)
+                for a in addr)
+        elif addr is None:
+            crit["addrs"] = None
+        else:
+            raise ValueError("address must be a hex string or array")
+        tops = flt.get("topics")
+        if tops:
+            norm = []
+            for want in tops:
+                if want is None:
+                    norm.append(None)           # wildcard position
+                else:
+                    opts = want if isinstance(want, list) else [want]
+                    norm.append([_decode(o) if isinstance(o, str)
+                                 else bytes(o) for o in opts])
+            crit["topics"] = norm
+        else:
+            crit["topics"] = None
+        return crit
+
+    def _eth_logs(self, rt, crit, frm=None):
+        """Shared by eth_getLogs / eth_getFilterLogs / filter polling.
+        ``crit`` is normalized; ``frm`` (poll cursor) only ever
+        narrows the client's fromBlock, never widens it."""
+        lo = crit["frm"] if frm is None else max(frm, crit["frm"])
         # clamp: an attacker-chosen huge toBlock must not spin the
         # range loop while holding the node lock
-        to = min(self._blocknum(flt.get("toBlock"), rt.state.block),
+        to = min(self._blocknum(crit["to"], rt.state.block),
                  rt.state.block)
-        addr = flt.get("address")
-        addrs = None
-        if isinstance(addr, str):
-            addrs = {_decode(addr)}
-        elif isinstance(addr, list):     # arrays are valid per the spec
-            addrs = {_decode(a) if isinstance(a, str) else a
-                     for a in addr}
-        logs = rt.evm.logs_in_range(frm, to)
-        if addrs is not None:
-            logs = [lg for lg in logs if lg["address"] in addrs]
-        want_topics = flt.get("topics")
-        if want_topics:
+        logs = rt.evm.logs_in_range(lo, to)
+        if crit["addrs"] is not None:
+            logs = [lg for lg in logs if lg["address"] in crit["addrs"]]
+        if crit["topics"]:
             def tmatch(lg):
                 lt = lg["topics"]
-                for i, want in enumerate(want_topics):
-                    if want is None:
-                        continue   # wildcard position
-                    opts = want if isinstance(want, list) else [want]
-                    opts = [_decode(o) if isinstance(o, str) else o
-                            for o in opts]
+                for i, opts in enumerate(crit["topics"]):
+                    if opts is None:
+                        continue
                     if i >= len(lt) or lt[i] not in opts:
                         return False
                 return True
@@ -378,19 +407,17 @@ class RpcServer:
                 del self._filters[fid]
             if len(self._filters) >= self.MAX_FILTERS:
                 raise RpcError(SERVER_ERROR, "filter table full")
+        crit = None
         if kind == "log":
-            # validate criteria at creation, where the spec reports
-            # errors — not on every later poll
             try:
-                self._eth_logs(self.node.runtime, criteria,
-                               frm=self.node.head().number + 1)
+                crit = self._norm_criteria(criteria)
             except (ValueError, TypeError) as e:
                 raise RpcError(INVALID_PARAMS,
                                f"bad filter criteria: {e}") from e
         head = self.node.head()           # handle() runs under the lock
         self._filter_seq += 1
         fid = hex(self._filter_seq)
-        self._filters[fid] = {"type": kind, "criteria": criteria,
+        self._filters[fid] = {"type": kind, "criteria": crit,
                               "cursor": head.number,
                               "cursor_hash": head.hash(),
                               "touched": now}

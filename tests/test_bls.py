@@ -217,3 +217,45 @@ def test_legacy_worker_without_bls_still_accepted():
     rt.apply_extrinsic("tee1", "audit.submit_verify_result", "m1", True,
                        True)
     assert rt.audit.verdicts() == ()   # nothing sealed, nothing logged
+
+
+# -- native backend (cess_tpu/native/bls381.cpp) ------------------------------
+
+def test_native_differential_sign_verify():
+    """The C++ backend must be byte-identical to the Python oracle on
+    signatures and agree on every verify (SURVEY 2.3: C++ BLS12-381
+    host-side). Skipped only where no toolchain is available."""
+    bls_native = pytest.importorskip("cess_tpu.crypto.bls_native")
+    for i in range(3):
+        seed = b"diff-%d" % i
+        sk = 0
+        import hashlib, hmac
+        salt = b"CESS_TPU_BLS_KEYGEN"
+        while sk == 0:
+            sk = int.from_bytes(hmac.new(salt, seed,
+                                         hashlib.sha512).digest(),
+                                "big") % bls.R
+            salt = hashlib.sha256(salt).digest()
+        sk32 = sk.to_bytes(32, "big")
+        # pk derivation matches the pure construction
+        assert bls_native.pk_from_sk(sk32) \
+            == bls.g2_compress(bls._g2_mul(bls.G2_GEN, sk))
+        msg = b"diff message %d" % i
+        sig_py = bls.g1_compress(bls._g1_mul(bls.hash_to_g1(msg), sk))
+        assert bls_native.sign(sk32, msg, bls.DST_G1) == sig_py
+        pk = bls_native.pk_from_sk(sk32)
+        assert bls_native.verify(pk, msg, sig_py, bls.DST_G1)
+        assert not bls_native.verify(pk, msg + b"!", sig_py, bls.DST_G1)
+
+
+def test_pure_python_fallback_agrees(monkeypatch):
+    """With the native backend disabled the module must still produce
+    the same bytes and verdicts (the no-toolchain deployment path)."""
+    sk, pk = bls.keygen(b"fallback-seed")
+    sig = bls.sign(sk, b"fallback msg")
+    monkeypatch.setattr(bls, "_native", None)
+    sk2, pk2 = bls.keygen(b"fallback-seed")
+    assert (sk2, pk2) == (sk, pk)
+    assert bls.sign(sk2, b"fallback msg") == sig
+    assert bls.verify(pk, b"fallback msg", sig)
+    assert not bls.verify(pk, b"fallback msh", sig)

@@ -301,3 +301,46 @@ def test_eth_filter_hardening():
     f["cursor"], f["cursor_hash"] = 2, b"\x00" * 32   # simulate reorg
     redelivered = srv.handle("eth_getFilterChanges", [fid])
     assert len(redelivered) == 1                      # block-2 log again
+
+
+def test_eth_filter_criteria_semantics():
+    """Review findings: topics validated at creation; fromBlock bounds
+    the poll window (cursor only narrows, never widens)."""
+    import pytest
+
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcError, RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "fc", {"alice": spec.session_key("alice")})
+    srv = RpcServer(node, port=0)
+    node.submit_extrinsic("alice", "evm.deploy", TOKEN_INIT)
+    node.try_author(1) and node.commit_proposal()
+    addr = [k[0] for k, _ in
+            node.runtime.state.iter_prefix("evm", "code")][0]
+
+    # malformed TOPICS rejected at creation, not first poll
+    with pytest.raises(RpcError, match="bad filter criteria"):
+        srv.handle("eth_newFilter", [{"topics": ["0xzz"]}])
+    with pytest.raises(RpcError, match="bad filter criteria"):
+        srv.handle("eth_getLogs", [{"address": 42}])
+
+    # fromBlock in the future excludes earlier logs from polls
+    fut = srv.handle("eth_newFilter",
+                     [{"fromBlock": hex(10), "address": "0x" + addr.hex()}])
+    now = srv.handle("eth_newFilter", [{"address": "0x" + addr.hex()}])
+    node.submit_extrinsic("alice", "evm.call", addr,
+                          calldata(1, eth_address("bob"), 3))
+    node.try_author(2) and node.commit_proposal()
+    assert srv.handle("eth_getFilterChanges", [fut]) == []   # block 2 < 10
+    assert len(srv.handle("eth_getFilterChanges", [now])) == 1
+    # topic selection with pre-decoded options
+    tf = srv.handle("eth_newFilter",
+                    [{"fromBlock": 0,
+                      "topics": [["0x" + word(eth_address("bob")).hex()]]}])
+    assert len(srv.handle("eth_getFilterLogs", [tf])) == 1
+    tmiss = srv.handle("eth_newFilter",
+                       [{"fromBlock": 0,
+                         "topics": [["0x" + word(b"\x01" * 20).hex()]]}])
+    assert srv.handle("eth_getFilterLogs", [tmiss]) == []
