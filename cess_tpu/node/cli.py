@@ -186,7 +186,8 @@ def _run_tcp_node(args, spec) -> int:
                       genesis_time=args.genesis_time)
     rpc = None
     if args.rpc_port:
-        rpc = RpcServer(node, port=args.rpc_port, lock=svc.lock).start()
+        rpc = RpcServer(node, port=args.rpc_port, lock=svc.lock,
+                        service=svc).start()
         print(f"JSON-RPC on 127.0.0.1:{rpc.port}", file=sys.stderr)
     svc.start()
     print(f"node {name} on :{args.port}, peers {peers}", file=sys.stderr)

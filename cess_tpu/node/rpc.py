@@ -8,11 +8,15 @@ framework — the reference uses SCALE).
 
 Methods:
   system_chain, system_health, system_properties
-  chain_getHeader [number?], chain_getFinalizedHead, chain_getBlockNumber
+  chain_getHeader [number?], chain_getBlock [number?],
+  chain_getFinalizedHead, chain_getBlockNumber
   state_getStorage [pallet, item, key-parts...], state_getEvents [pallet?]
   author_submitExtrinsic [origin, call, args...]   (dev-signed)
   author_submitSignedExtrinsic [hex codec-encoded SignedExtrinsic]
   system_accountNextIndex [account]
+  payment_queryInfo [hex extrinsic]   (TransactionPayment role)
+  rrsc_epoch, grandpa_roundState, grandpa_proveFinality [round],
+  sync_state_genSyncSpec, net_peerCount, net_listening
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
@@ -81,8 +85,11 @@ def _decode(obj):
 
 class RpcServer:
     def __init__(self, node: Node, host: str = "127.0.0.1",
-                 port: int = 9944, lock=None):
+                 port: int = 9944, lock=None, service=None):
         self.node = node
+        # optional NodeService backref: live peer/listening telemetry
+        # for the system/net namespaces
+        self.service = service
         # the block-producing side must hold the SAME lock while
         # mutating node/runtime state (cli loop, NodeService): RPC
         # reads iterate live dicts and would otherwise race
@@ -186,8 +193,9 @@ class RpcServer:
         if method == "system_chain":
             return node.spec.name
         if method == "system_health":
-            return {"peers": 0, "isSyncing": False,
-                    "shouldHavePeers": False}
+            peers = self._peer_count()
+            return {"peers": peers, "isSyncing": False,
+                    "shouldHavePeers": self.service is not None}
         if method == "system_properties":
             return {"chainId": node.spec.chain_id,
                     "fragmentCount": node.spec.fragment_count}
@@ -242,6 +250,74 @@ class RpcServer:
             from .metrics import collect
 
             return collect(node)
+        if method == "chain_getBlock":
+            n = params[0] if params else node.head().number
+            if not isinstance(n, int):
+                raise RpcError(INVALID_PARAMS, "expected [number]")
+            blk = node.block_bodies.get(n)
+            if blk is None and 0 <= n < len(node.chain):
+                blk = node.bodies.get(node.chain[n].hash())
+            if blk is None:
+                return None   # pruned by warp sync, or unknown
+            return {"header": blk.header,
+                    "extrinsics": list(blk.extrinsics)}
+        if method == "payment_queryInfo":
+            # TransactionPayment analog (ref rpc.rs TransactionPayment):
+            # fee breakdown for an encoded signed extrinsic
+            from .. import codec as _codec
+            from ..chain.extrinsic import SignedExtrinsic
+            from ..chain.runtime import CALL_WEIGHTS
+
+            if not params or not isinstance(params[0], str):
+                raise RpcError(INVALID_PARAMS, "expected [hex extrinsic]")
+            xt = _codec.decode(_decode(params[0]))
+            if not isinstance(xt, SignedExtrinsic):
+                raise RpcError(INVALID_PARAMS,
+                               "bytes do not decode to a SignedExtrinsic")
+            return {"weight": CALL_WEIGHTS.get(xt.call, 0),
+                    "partialFee": rt.tx_fee(xt)}
+        # -- consensus namespaces (RRSC/Grandpa/SyncState analogs;
+        # ref node/src/rpc.rs:148-227) -----------------------------------
+        if method == "rrsc_epoch":
+            head = node.head()
+            slot = head.claim.slot if head.claim else 0
+            epoch = node.rrsc.epoch_of(slot)
+            return {"epoch": epoch,
+                    "epochLength": node.rrsc.epoch_blocks,
+                    "randomness": node.rrsc.epoch_randomness(epoch),
+                    "authorities": list(node.authorities)}
+        if method == "grandpa_roundState":
+            rounds = sorted(node.finality.justifications)
+            return {"finalized": node.finalized,
+                    "bestRound": rounds[-1] if rounds else 0,
+                    "authorities": list(node.authorities)}
+        if method == "grandpa_proveFinality":
+            # newest justification at-or-above the asked round (newer
+            # justifications imply older finality)
+            want = params[0] if params else 0
+            if not isinstance(want, int):
+                raise RpcError(INVALID_PARAMS, "expected [round]")
+            from .. import codec as _codec
+
+            rounds = sorted(r for r in node.finality.justifications
+                            if r >= want)
+            if not rounds:
+                return None
+            return _codec.encode(node.finality.justifications[rounds[0]])
+        if method == "sync_state_genSyncSpec":
+            # the warp/light sync bootstrap document (ref
+            # cessc-sync-state-rpc role): chain spec + finalized anchor
+            from .chain_spec import spec_to_json
+
+            return {"spec": spec_to_json(node.spec),
+                    "lightSyncState": {
+                        "finalizedNumber": node.finalized,
+                        "finalizedHash": node.chain[node.finalized].hash()
+                        if node.finalized < len(node.chain) else None}}
+        if method == "net_peerCount":
+            return hex(self._peer_count())
+        if method == "net_listening":
+            return self.service is not None
         # -- Eth namespace (Frontier RPC compat surface over the EVM
         # boundary module; ref node/src/rpc.rs:229-328) ------------------
         if method == "web3_clientVersion":
@@ -323,6 +399,11 @@ class RpcServer:
             return hex(rt.evm.storage_at(_decode(params[0]), slot))
         raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")
 
+    def _peer_count(self) -> int:
+        if self.service is None:
+            return 0
+        return sum(1 for c in self.service.conns if c.alive)
+
     # -- Eth filters (the EthFilter namespace, node/src/rpc.rs:229-328) ----
     @staticmethod
     def _blocknum(v, default):
@@ -342,12 +423,19 @@ class RpcServer:
                 "to": flt.get("toBlock")}
         self._blocknum(crit["to"], 0)           # parse-check now
         addr = flt.get("address")
+        def as_bytes(v):
+            # hex strings or raw bytes ONLY — bytes(int) would allocate
+            # attacker-sized zero buffers under the node lock
+            if isinstance(v, str):
+                return _decode(v)
+            if isinstance(v, (bytes, bytearray)):
+                return bytes(v)
+            raise ValueError(f"expected hex string, got {type(v).__name__}")
+
         if isinstance(addr, str):
             crit["addrs"] = frozenset({_decode(addr)})
         elif isinstance(addr, list):            # arrays are valid per spec
-            crit["addrs"] = frozenset(
-                _decode(a) if isinstance(a, str) else bytes(a)
-                for a in addr)
+            crit["addrs"] = frozenset(as_bytes(a) for a in addr)
         elif addr is None:
             crit["addrs"] = None
         else:
@@ -360,8 +448,7 @@ class RpcServer:
                     norm.append(None)           # wildcard position
                 else:
                     opts = want if isinstance(want, list) else [want]
-                    norm.append([_decode(o) if isinstance(o, str)
-                                 else bytes(o) for o in opts])
+                    norm.append([as_bytes(o) for o in opts])
             crit["topics"] = norm
         else:
             crit["topics"] = None

@@ -24,8 +24,11 @@ _SO = os.path.join(_NATIVE_DIR, "libcessrs.so")
 
 
 def _build() -> None:
-    subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
-                   capture_output=True)
+    # build ONLY the RS target: a compile failure in another native
+    # backend (e.g. bls381.cpp on an exotic toolchain) must not take
+    # down this one
+    subprocess.run(["make", "-C", _NATIVE_DIR, "-s", "libcessrs.so"],
+                   check=True, capture_output=True)
 
 
 def _load() -> ctypes.CDLL:

@@ -259,3 +259,61 @@ def test_cli_key_tools_and_block_tools(tmp_path, capsys):
     capsys.readouterr()
     assert main(["check-block", "--dev", "--base-path", base]) == 0
     assert json.loads(capsys.readouterr().out)["number"] == 3
+
+
+def test_rpc_consensus_and_payment_namespaces():
+    """The RRSC/Grandpa/SyncState/TransactionPayment/Net analog surface
+    (ref node/src/rpc.rs:148-328)."""
+    from cess_tpu import codec
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "n0", {"alice": spec.session_key("alice")})
+    net = Network([node])
+    net.run_slots(4)
+    rpc = RpcServer(node, port=0).start()
+    try:
+        def call(method, *params):
+            req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{rpc.port}", data=req,
+                    headers={"Content-Type": "application/json"})) as r:
+                out = json.loads(r.read())
+            assert "error" not in out, out
+            return out["result"]
+
+        ep = call("rrsc_epoch")
+        assert ep["epoch"] == 0 and ep["authorities"] == ["alice"]
+        assert ep["epochLength"] == spec.epoch_blocks
+
+        blk = call("chain_getBlock", 1)
+        assert blk["header"]["number"] == 1
+
+        # finality proof: round-trips through the codec and names a
+        # finalized target
+        rs = call("grandpa_roundState")
+        assert rs["finalized"] >= 1
+        proof = call("grandpa_proveFinality", 1)
+        just = codec.decode(bytes.fromhex(proof[2:]))
+        assert just.round >= 1 and len(just.votes) >= 1
+
+        # fee estimate matches the runtime's charge for the same bytes
+        xt = sign_extrinsic(
+            spec.account_key("alice"), node.runtime.genesis_hash(),
+            "alice", node.runtime.system.nonce("alice"),
+            "balances.transfer", ("bob", 5), ())
+        info = call("payment_queryInfo", "0x" + codec.encode(xt).hex())
+        assert info["partialFee"] == node.runtime.tx_fee(xt)
+
+        sync = call("sync_state_genSyncSpec")
+        assert sync["spec"]["chain_id"] == spec.chain_id
+        assert sync["lightSyncState"]["finalizedNumber"] >= 1
+
+        # no NodeService attached: net telemetry reports not-listening
+        assert call("net_peerCount") == "0x0"
+        assert call("net_listening") is False
+        assert call("system_health")["peers"] == 0
+    finally:
+        rpc.stop()

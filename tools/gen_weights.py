@@ -141,6 +141,87 @@ def scenarios():
     def xfer(rt):
         return "alice", ("balances.transfer", "bob", 1 * D)
 
+    def _tee_env(rt):
+        from cess_tpu.chain.attestation import issue_cert
+        from cess_tpu.crypto.rsa import generate_rsa_keypair
+
+        if "tee_env" not in counter:
+            root_kp = generate_rsa_keypair(1024, seed=101)
+            signer_kp = generate_rsa_keypair(1024, seed=102)
+            mr = b"\x31" * 32
+            rt.apply_extrinsic("root", "tee_worker.update_whitelist", mr)
+            rt.apply_extrinsic("root", "tee_worker.pin_ias_signer",
+                               root_kp.public)
+            cert = issue_cert(root_kp, "ias", signer_kp.public)
+            counter["tee_env"] = (signer_kp, mr, cert)
+        return counter["tee_env"]
+
+    def tee_register(rt):
+        # full cost: cert-chain + report verification + BLS PoP pairing
+        from cess_tpu.chain.attestation import issue_report
+        from cess_tpu.crypto import bls12381
+
+        signer_kp, mr, cert = _tee_env(rt)
+        i = nxt()
+        c, stash = f"tee{i}", f"tst{i}"
+        rt.fund(stash, 10_000_000 * D)
+        rt.apply_extrinsic(stash, "staking.bond", 2_000_000 * D)
+        sk, pk = bls12381.keygen(b"wt%d" % i)
+        pop = bls12381.prove_possession(sk, pk)
+        report, sig = issue_report(signer_kp, mr, b"ppk", c, bls_pk=pk)
+        return c, ("tee_worker.register", stash, b"peer", b"ppk",
+                   report, sig, (cert,), pk, pop)
+
+    def verify_result(rt):
+        # BLS-sealed verdict: the on-chain pairing check dominates
+        from cess_tpu.chain import audit as audit_mod
+        from cess_tpu.chain.audit import (ChallengeInfo, MinerSnapshot,
+                                          NetSnapshot, ProveInfo)
+        from cess_tpu.chain.attestation import issue_report
+        from cess_tpu.crypto import bls12381
+
+        if "tee_v" not in counter:
+            signer_kp, mr, cert = _tee_env(rt)
+            c, stash = "teev", "tstv"
+            rt.fund(stash, 10_000_000 * D)
+            rt.apply_extrinsic(stash, "staking.bond", 2_000_000 * D)
+            sk, pk = bls12381.keygen(b"verdict-weight")
+            report, sig = issue_report(signer_kp, mr, b"ppk", c, bls_pk=pk)
+            rt.apply_extrinsic(c, "tee_worker.register", stash, b"peer",
+                               b"ppk", report, sig, (cert,), pk,
+                               bls12381.prove_possession(sk, pk))
+            counter["tee_v"] = (c, sk)
+        tee, sk = counter["tee_v"]
+        i = nxt()
+        miner = "m%d" % (i % 6)
+        snap = MinerSnapshot(miner=miner, idle_space=0, service_space=10)
+        nets = NetSnapshot(total_reward=0, total_idle_space=0,
+                           total_service_space=10, random_indices=(1,),
+                           randoms=(b"\x01" * 20,))
+        rt.state.put("audit", "challenge", ChallengeInfo(
+            net=nets, miners=(snap,), start=rt.state.block,
+            challenge_deadline=rt.state.block + 10**6,
+            verify_deadline=rt.state.block + 10**6))
+        mission = ProveInfo(miner=miner, snapshot=snap,
+                            idle_proof=b"ip%d" % i, service_proof=b"sp")
+        rt.state.put("audit", "unverify", tee, (mission,))
+        sig = bls12381.sign(sk, audit_mod.verdict_message(
+            tee, audit_mod.mission_digest(mission), True, True))
+        return tee, ("audit.submit_verify_result", miner, True, True,
+                     sig)
+
+    def contracts_deploy(rt):
+        return "alice", ("contracts.deploy",
+                         (("input",), ("push", 1), ("index",),
+                          ("return",)))
+
+    def contracts_call(rt):
+        if "caddr" not in counter:
+            counter["caddr"] = rt.apply_extrinsic(
+                "alice", "contracts.deploy",
+                (("input",), ("push", 1), ("index",), ("return",)))
+        return "alice", ("contracts.call", counter["caddr"], "m", (1, 2))
+
     return {
         "balances.transfer": xfer,
         "file_bank.upload_declaration": upload,
@@ -156,6 +237,10 @@ def scenarios():
         "treasury.propose_bounty": bounty,
         "evm.deploy": evm_deploy,
         "evm.call": evm_call,
+        "tee_worker.register": tee_register,
+        "audit.submit_verify_result": verify_result,
+        "contracts.deploy": contracts_deploy,
+        "contracts.call": contracts_call,
     }
 
 
