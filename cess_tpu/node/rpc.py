@@ -17,6 +17,8 @@ Methods:
   payment_queryInfo [hex extrinsic]   (TransactionPayment role)
   rrsc_epoch, grandpa_roundState, grandpa_proveFinality [round],
   sync_state_genSyncSpec, net_peerCount, net_listening
+  mmr_root, mmr_generateProof [number], mmr_verifyProof [...]
+  (header-inclusion proofs; pallet-mmr role)
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
@@ -98,6 +100,8 @@ class RpcServer:
         # criteria, cursor}; bounded at MAX_FILTERS
         self._filters: dict[str, dict] = {}
         self._filter_seq = 0
+        from .mmr import HeaderMmr
+        self._header_mmr = HeaderMmr()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -270,7 +274,13 @@ class RpcServer:
 
             if not params or not isinstance(params[0], str):
                 raise RpcError(INVALID_PARAMS, "expected [hex extrinsic]")
-            xt = _codec.decode(_decode(params[0]))
+            try:
+                raw = _decode(params[0])
+                if not isinstance(raw, bytes):
+                    raise ValueError("hex must be 0x-prefixed")
+                xt = _codec.decode(raw)
+            except (ValueError, _codec.CodecError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
             if not isinstance(xt, SignedExtrinsic):
                 raise RpcError(INVALID_PARAMS,
                                "bytes do not decode to a SignedExtrinsic")
@@ -318,6 +328,43 @@ class RpcServer:
             return hex(self._peer_count())
         if method == "net_listening":
             return self.service is not None
+        # -- Mmr namespace (pallet-mmr role, ref runtime/src/lib.rs
+        # :1270-1274,1492; node Mmr RPC) ---------------------------------
+        if method == "mmr_root":
+            return self._header_mmr.sync(node.chain).root()
+        if method == "mmr_generateProof":
+            if not params or not isinstance(params[0], int):
+                raise RpcError(INVALID_PARAMS, "expected [block number]")
+            n = params[0]
+            if not 0 <= n < len(node.chain):
+                raise RpcError(INVALID_PARAMS, f"unknown block {n}")
+            from .. import codec as _codec
+
+            mmr = self._header_mmr.sync(node.chain)
+            return {"blockNumber": n,
+                    "headerHash": node.chain[n].hash(),
+                    "root": mmr.root(),
+                    "proof": _codec.encode(mmr.proof(n))}
+        if method == "mmr_verifyProof":
+            # stateless check (the light-client half exposed for tools)
+            from .. import codec as _codec
+            from . import mmr as mmr_mod
+
+            if len(params) < 4:
+                raise RpcError(INVALID_PARAMS,
+                               "expected [root, number, hash, proof]")
+            root, number, hh = (_decode(params[0]), params[1],
+                                _decode(params[2]))
+            if not (isinstance(root, bytes) and isinstance(hh, bytes)
+                    and isinstance(number, int)
+                    and not isinstance(number, bool) and number >= 0):
+                raise RpcError(INVALID_PARAMS,
+                               "expected [0x-root, int number, 0x-hash]")
+            try:
+                proof = _codec.decode(_decode(params[3]))
+            except (ValueError, _codec.CodecError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
+            return mmr_mod.verify_proof(root, number, hh, proof)
         # -- Eth namespace (Frontier RPC compat surface over the EVM
         # boundary module; ref node/src/rpc.rs:229-328) ------------------
         if method == "web3_clientVersion":
@@ -424,16 +471,21 @@ class RpcServer:
         self._blocknum(crit["to"], 0)           # parse-check now
         addr = flt.get("address")
         def as_bytes(v):
-            # hex strings or raw bytes ONLY — bytes(int) would allocate
-            # attacker-sized zero buffers under the node lock
+            # 0x-hex strings or raw bytes ONLY — bytes(int) would
+            # allocate attacker-sized zero buffers under the node lock,
+            # and a prefixless hex string would silently never match
             if isinstance(v, str):
-                return _decode(v)
+                got = _decode(v)
+                if not isinstance(got, bytes):
+                    raise ValueError(f"hex string must be 0x-prefixed: "
+                                     f"{v[:16]!r}")
+                return got
             if isinstance(v, (bytes, bytearray)):
                 return bytes(v)
             raise ValueError(f"expected hex string, got {type(v).__name__}")
 
         if isinstance(addr, str):
-            crit["addrs"] = frozenset({_decode(addr)})
+            crit["addrs"] = frozenset({as_bytes(addr)})
         elif isinstance(addr, list):            # arrays are valid per spec
             crit["addrs"] = frozenset(as_bytes(a) for a in addr)
         elif addr is None:
