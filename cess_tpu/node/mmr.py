@@ -135,19 +135,26 @@ def verify_proof(root: bytes, number: int, header_hash: bytes,
     no chain access (the light-client half)."""
     if not isinstance(proof, MmrProof) \
             or not isinstance(proof.leaf_count, int) \
-            or isinstance(proof.leaf_count, bool) or proof.leaf_count <= 0 \
+            or isinstance(proof.leaf_count, bool) \
+            or not 0 < proof.leaf_count < 1 << 63 \
+            or not isinstance(number, int) or isinstance(number, bool) \
+            or not 0 <= number < 1 << 63 \
+            or not isinstance(header_hash, bytes) \
             or not all(isinstance(pk, bytes) for pk in
                        tuple(proof.peaks_left) + tuple(proof.peaks_right)):
-        return False   # crafted proofs fail closed, never raise
-    acc = leaf_hash(number, header_hash)
-    for item in proof.path:
-        if not (isinstance(item, tuple) and len(item) == 2
-                and isinstance(item[0], bytes)):
-            return False
-        sib, sib_is_right = item
-        acc = _node_hash(acc, sib) if sib_is_right else _node_hash(sib, acc)
-    peaks = list(proof.peaks_left) + [acc] + list(proof.peaks_right)
-    return _root_hash(proof.leaf_count, peaks) == root
+        return False   # crafted inputs fail closed, never raise
+    try:
+        acc = leaf_hash(number, header_hash)
+        for item in proof.path:
+            if not (isinstance(item, tuple) and len(item) == 2
+                    and isinstance(item[0], bytes)):
+                return False
+            sib, right = item
+            acc = _node_hash(acc, sib) if right else _node_hash(sib, acc)
+        peaks = list(proof.peaks_left) + [acc] + list(proof.peaks_right)
+        return _root_hash(proof.leaf_count, peaks) == root
+    except (TypeError, ValueError, OverflowError):
+        return False   # belt-and-braces: the contract is bool, not raise
 
 
 class HeaderMmr:

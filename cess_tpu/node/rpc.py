@@ -24,6 +24,9 @@ Methods:
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
   exactly-once delivery (ref node/src/rpc.rs:229-328)
+  GET /ws upgrades to WebSocket for the EthPubSub role:
+  eth_subscribe ["newHeads" | "logs", criteria] push notifications
+  (cess_tpu/node/ws.py)
 """
 from __future__ import annotations
 
@@ -109,6 +112,26 @@ class RpcServer:
                 pass
 
             def do_GET(self):
+                if self.path == "/ws" and "websocket" in \
+                        self.headers.get("Upgrade", "").lower():
+                    # EthPubSub endpoint: RFC 6455 upgrade, then the
+                    # connection belongs to the subscription loop
+                    from . import ws as ws_mod
+
+                    key = self.headers.get("Sec-WebSocket-Key", "")
+                    if not key:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    self.send_response(101)
+                    self.send_header("Upgrade", "websocket")
+                    self.send_header("Connection", "Upgrade")
+                    self.send_header("Sec-WebSocket-Accept",
+                                     ws_mod.accept_key(key))
+                    self.end_headers()
+                    self.close_connection = True
+                    ws_mod.serve_connection(server, self)
+                    return
                 if self.path != "/metrics":
                     self.send_response(404)
                     self.end_headers()
@@ -230,7 +253,7 @@ class RpcServer:
             # production path: client-built SignedExtrinsic, codec-encoded hex
             from .. import codec as _codec
 
-            xt = _codec.decode(_decode(params[0]))
+            xt = self._decode_extrinsic_param(params)
             node.submit_signed(xt)
             return True
         if method == "system_accountNextIndex":
@@ -268,22 +291,9 @@ class RpcServer:
         if method == "payment_queryInfo":
             # TransactionPayment analog (ref rpc.rs TransactionPayment):
             # fee breakdown for an encoded signed extrinsic
-            from .. import codec as _codec
-            from ..chain.extrinsic import SignedExtrinsic
             from ..chain.runtime import CALL_WEIGHTS
 
-            if not params or not isinstance(params[0], str):
-                raise RpcError(INVALID_PARAMS, "expected [hex extrinsic]")
-            try:
-                raw = _decode(params[0])
-                if not isinstance(raw, bytes):
-                    raise ValueError("hex must be 0x-prefixed")
-                xt = _codec.decode(raw)
-            except (ValueError, _codec.CodecError) as e:
-                raise RpcError(INVALID_PARAMS, str(e)) from e
-            if not isinstance(xt, SignedExtrinsic):
-                raise RpcError(INVALID_PARAMS,
-                               "bytes do not decode to a SignedExtrinsic")
+            xt = self._decode_extrinsic_param(params)
             return {"weight": CALL_WEIGHTS.get(xt.call, 0),
                     "partialFee": rt.tx_fee(xt)}
         # -- consensus namespaces (RRSC/Grandpa/SyncState analogs;
@@ -333,7 +343,8 @@ class RpcServer:
         if method == "mmr_root":
             return self._header_mmr.sync(node.chain).root()
         if method == "mmr_generateProof":
-            if not params or not isinstance(params[0], int):
+            if not params or not isinstance(params[0], int) \
+                    or isinstance(params[0], bool):
                 raise RpcError(INVALID_PARAMS, "expected [block number]")
             n = params[0]
             if not 0 <= n < len(node.chain):
@@ -398,10 +409,8 @@ class RpcServer:
             # is a codec-encoded SignedExtrinsic carrying an evm.* call
             from .. import codec as _codec
 
-            if not params:
-                raise RpcError(INVALID_PARAMS, "expected [raw tx hex]")
-            xt = _codec.decode(_decode(params[0]))
-            if not getattr(xt, "call", "").startswith("evm."):
+            xt = self._decode_extrinsic_param(params)
+            if not xt.call.startswith("evm."):
                 raise RpcError(INVALID_PARAMS,
                                "raw tx must carry an evm.* call")
             node.submit_signed(xt)
@@ -445,6 +454,27 @@ class RpcServer:
             slot = int(slot, 16) if isinstance(slot, str) else int(slot)
             return hex(rt.evm.storage_at(_decode(params[0]), slot))
         raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")
+
+    @staticmethod
+    def _decode_extrinsic_param(params) -> "object":
+        """One decode contract for every hex-extrinsic parameter:
+        malformed input is INVALID_PARAMS, never a server error."""
+        from .. import codec as _codec
+        from ..chain.extrinsic import SignedExtrinsic
+
+        if not params or not isinstance(params[0], str):
+            raise RpcError(INVALID_PARAMS, "expected [hex extrinsic]")
+        try:
+            raw = _decode(params[0])
+            if not isinstance(raw, bytes):
+                raise ValueError("hex must be 0x-prefixed")
+            xt = _codec.decode(raw)
+        except (ValueError, _codec.CodecError) as e:
+            raise RpcError(INVALID_PARAMS, str(e)) from e
+        if not isinstance(xt, SignedExtrinsic):
+            raise RpcError(INVALID_PARAMS,
+                           "bytes do not decode to a SignedExtrinsic")
+        return xt
 
     def _peer_count(self) -> int:
         if self.service is None:

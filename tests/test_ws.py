@@ -1,0 +1,138 @@
+"""EthPubSub over WebSocket (ref node/src/rpc.rs:229-328 EthPubSub):
+handshake, newHeads + logs subscriptions with push delivery,
+unsubscribe, and bad-input rejection — driven by a raw RFC 6455
+client so the server's framing is tested from the wire."""
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+from cess_tpu.node import ws as ws_mod
+from cess_tpu.node.chain_spec import dev_spec
+from cess_tpu.node.network import Network, Node
+from cess_tpu.node.rpc import RpcServer
+
+from test_evm import TOKEN_INIT, calldata
+from cess_tpu.chain.evm import eth_address
+
+
+class WsClient:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall((
+            f"GET /ws HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0]
+        want = ws_mod.accept_key(key).encode()
+        assert want in resp, "bad Sec-WebSocket-Accept"
+
+    def send(self, obj):
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        n = len(payload)
+        hdr = bytes([0x81, 0x80 | n]) if n < 126 else \
+            bytes([0x81, 0x80 | 126]) + struct.pack(">H", n)
+        body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(hdr + mask + body)
+
+    def recv(self, timeout=10.0):
+        self.sock.settimeout(timeout)
+        hdr = self._exact(2)
+        length = hdr[1] & 0x7F
+        if length == 126:
+            length = struct.unpack(">H", self._exact(2))[0]
+        elif length == 127:
+            length = struct.unpack(">Q", self._exact(8))[0]
+        assert not hdr[1] & 0x80, "server frames must be unmasked"
+        return json.loads(self._exact(length))
+
+    def _exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            assert chunk, "connection closed"
+            buf += chunk
+        return buf
+
+    def close(self):
+        self.sock.close()
+
+
+def test_pubsub_newheads_logs_and_unsubscribe():
+    spec = dev_spec()
+    node = Node(spec, "ws", {"alice": spec.session_key("alice")})
+    net = Network([node])
+    net.run_slots(1)
+    srv = RpcServer(node, port=0).start()
+    try:
+        cli = WsClient(srv.port)
+        # subscribe to heads and to this token's logs
+        cli.send({"jsonrpc": "2.0", "id": 1,
+                  "method": "eth_subscribe", "params": ["newHeads"]})
+        heads_sub = cli.recv()["result"]
+        node.submit_extrinsic("alice", "evm.deploy", TOKEN_INIT)
+        net.run_slots(1)
+        addr = [k[0] for k, _ in
+                node.runtime.state.iter_prefix("evm", "code")][0]
+        cli.send({"jsonrpc": "2.0", "id": 2, "method": "eth_subscribe",
+                  "params": ["logs", {"address": "0x" + addr.hex()}]})
+        # collect the subscribe ack (the block-2 head push may arrive
+        # around it in any order)
+        msgs = [cli.recv()]
+        while "result" not in msgs[-1] or msgs[-1].get("id") != 2:
+            msgs.append(cli.recv())
+        logs_sub = msgs[-1]["result"]
+        assert logs_sub != heads_sub
+
+        # a transfer lands in block 3: BOTH subscriptions must push
+        node.submit_extrinsic("alice", "evm.call", addr,
+                              calldata(1, eth_address("bob"), 42))
+        net.run_slots(1)
+        got_head, got_log = None, None
+        deadline = time.time() + 10
+        while (got_head is None or got_log is None) \
+                and time.time() < deadline:
+            m = cli.recv()
+            if m.get("method") != "eth_subscription":
+                continue
+            p = m["params"]
+            if p["subscription"] == heads_sub \
+                    and p["result"]["number"] == 3:
+                got_head = p["result"]
+            if p["subscription"] == logs_sub:
+                got_log = p["result"]
+        assert got_head and got_head["author"] == "alice"
+        assert got_log and int.from_bytes(
+            bytes.fromhex(got_log["data"][2:]), "big") == 42
+
+        # unsubscribe stops delivery; unknown kinds are rejected
+        cli.send({"jsonrpc": "2.0", "id": 3, "method": "eth_unsubscribe",
+                  "params": [logs_sub]})
+        acks = [cli.recv()]
+        while "result" not in acks[-1]:
+            acks.append(cli.recv())
+        assert acks[-1]["result"] is True
+        cli.send({"jsonrpc": "2.0", "id": 4, "method": "eth_subscribe",
+                  "params": ["weird"]})
+        err = cli.recv()
+        while "error" not in err:
+            err = cli.recv()
+        assert err["error"]["code"] == -32602
+        cli.send({"jsonrpc": "2.0", "id": 5, "method": "eth_subscribe",
+                  "params": ["logs", {"address": "nohex"}]})
+        err = cli.recv()
+        while "error" not in err:
+            err = cli.recv()
+        assert err["error"]["code"] == -32602
+        cli.close()
+    finally:
+        srv.stop()
