@@ -8,9 +8,12 @@ boundary with a framework-native engine behind it
 (cess_tpu/chain/evm_interp.py): deploy runs INIT code and stores the
 returned runtime code; call/query execute the core opcode set with gas
 metering; contract storage lives in the chain KV; LOG0-4 entries are
-archived per block for eth_getLogs. Anything beyond the engine's
-surface (inter-contract CALL/CREATE) fails with ``evm.NotSupported`` —
-a typed capability refusal, not an AttributeError.
+archived per block for eth_getLogs. Inter-contract CALL / STATICCALL /
+DELEGATECALL execute through the recursive host below (depth-capped,
+commit-on-success overlays; query() routes ALL writes — inner frames
+included — into throwaway session overlays). Still out of scope:
+value-carrying calls and CREATE from bytecode — those fail cleanly
+(the call pushes 0), per the boundary's documented contract.
 
 Gas bounds block work: every call carries a gas limit capped at
 GAS_CAP, so a looping contract burns its gas and reverts — block
@@ -119,6 +122,61 @@ class Evm:
             raise DispatchError("evm.InvalidGas")
         return min(gas_limit, GAS_CAP)
 
+    MAX_CALL_DEPTH = 8
+
+    def _host(self, frame_addr: bytes, frame_caller: bytes, static: bool,
+              depth: int, sload, sstore, storage_for=None):
+        """call_host closure for one frame: services the CALL family
+        recursively. Inner frames run against a private overlay that
+        commits to the parent's storage hooks ONLY on success, so an
+        inner revert/halt unwinds its writes while the outer frame
+        continues (pallet-evm subcall semantics). ``storage_for(addr)``
+        supplies the base (load, store) hooks for a target address —
+        chain state for dispatched calls, a per-address session
+        overlay for query() so eth_call can NEVER write real state.
+        Value transfer is out of scope (value != 0 fails the call),
+        depth is capped."""
+        if storage_for is None:
+            def storage_for(a):
+                return self._sload(a), self._sstore(a)
+
+        def call_host(kind, to, data, fwd_gas, value):
+            if depth >= self.MAX_CALL_DEPTH or value != 0:
+                return 0, b"", 0, []
+            code = self.code_at(to)
+            if code is None:
+                return 1, b"", 0, []    # empty account: success, no-op
+            if kind == "delegate":      # callee code, CALLER storage
+                base_load, base_store = sload, sstore
+                inner_addr, inner_caller = frame_addr, frame_caller
+            else:
+                base_load, base_store = storage_for(to)
+                inner_addr, inner_caller = to, frame_addr
+            inner_static = static or kind == "static"
+            overlay: dict[int, int] = {}
+
+            def o_load(k: int) -> int:
+                return overlay[k] if k in overlay else base_load(k)
+
+            try:
+                res = evm_interp.execute(
+                    code, calldata=data, caller=inner_caller,
+                    address=inner_addr, gas_limit=fwd_gas,
+                    sload=o_load, sstore=overlay.__setitem__,
+                    static=inner_static,
+                    call_host=self._host(inner_addr, inner_caller,
+                                         inner_static, depth + 1,
+                                         o_load, overlay.__setitem__,
+                                         storage_for))
+            except EvmRevert as e:
+                return 0, e.data, e.gas_used, []
+            except EvmError:
+                return 0, b"", fwd_gas, []
+            for k, v in overlay.items():
+                base_store(k, v)        # commit on success only
+            return 1, res.output, res.gas_used, res.logs
+        return call_host
+
     def call(self, who: str, address: bytes, calldata: bytes,
              gas_limit: int = DEFAULT_GAS) -> bytes:
         """Execute a contract call; storage writes + logs commit with
@@ -129,11 +187,15 @@ class Evm:
         if not isinstance(calldata, bytes):
             raise DispatchError("evm.InvalidCall")
         gas_limit = self._check_gas(gas_limit)
+        caller = eth_address(who)
+        sload, sstore = self._sload(address), self._sstore(address)
         try:
             res = evm_interp.execute(
-                code, calldata=calldata, caller=eth_address(who),
+                code, calldata=calldata, caller=caller,
                 address=address, gas_limit=gas_limit,
-                sload=self._sload(address), sstore=self._sstore(address))
+                sload=sload, sstore=sstore,
+                call_host=self._host(address, caller, False, 0,
+                                     sload, sstore))
         except EvmRevert as e:
             raise DispatchError("evm.Reverted", e.data.hex()) from e
         except EvmError as e:
@@ -155,17 +217,29 @@ class Evm:
         if not isinstance(calldata, bytes):
             raise DispatchError("evm.InvalidCall")
         gas_limit = self._check_gas(gas_limit)
-        overlay: dict[int, int] = {}
-        base = self._sload(address)
+        # per-address session overlays: every write in this simulation
+        # — including writes by INNER calls to other contracts — lands
+        # here and is thrown away; chain state is read-only underneath
+        session: dict[bytes, dict[int, int]] = {}
 
-        def sload(k: int) -> int:
-            return overlay[k] if k in overlay else base(k)
+        def storage_for(a: bytes):
+            ov = session.setdefault(a, {})
+            base = self._sload(a)
 
+            def load(k: int) -> int:
+                return ov[k] if k in ov else base(k)
+
+            return load, ov.__setitem__
+
+        sload, sstore = storage_for(address)
+        caller_w = eth_address(caller)
         try:
             res = evm_interp.execute(
-                code, calldata=calldata, caller=eth_address(caller),
+                code, calldata=calldata, caller=caller_w,
                 address=address, gas_limit=gas_limit,
-                sload=sload, sstore=overlay.__setitem__)
+                sload=sload, sstore=sstore,
+                call_host=self._host(address, caller_w, False, 0,
+                                     sload, sstore, storage_for))
         except EvmRevert as e:
             raise DispatchError("evm.Reverted", e.data.hex()) from e
         except EvmError as e:

@@ -20,8 +20,11 @@ Deliberate deviations from mainnet EVM, documented once:
   LOG/SHA3/memory expansion), not the full Berlin/London schedule. Out
   of gas always consumes the limit and reverts state — an infinite
   loop can never stall block production (tested).
-- No inter-contract CALL/CREATE from within bytecode (the typed
-  ``evm.NotSupported`` refusal, matching the boundary's contract).
+- Inter-contract CALL / STATICCALL / DELEGATECALL run through a host
+  callback (evm.py recursion with commit-on-success overlays, depth
+  cap, 63/64 gas forwarding); value-carrying calls and CREATE from
+  within bytecode remain out of scope (the call fails cleanly —
+  push 0 — matching the boundary's documented contract).
 
 Execution state (storage, logs) is written through the transactional
 KV ``State``, so the runtime's dispatch transactionality applies:
@@ -55,11 +58,13 @@ G_LOG_TOPIC = 375
 G_LOG_DATA = 8
 G_MEM_WORD = 3
 G_COPY_WORD = 3
+G_CALL = 700
 
 
 class EvmRevert(Exception):
-    def __init__(self, data: bytes):
+    def __init__(self, data: bytes, gas_used: int = 0):
         self.data = data
+        self.gas_used = gas_used
 
 
 class EvmError(Exception):
@@ -154,12 +159,19 @@ def _valid_jumpdests(code: bytes) -> set[int]:
 
 def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             address: bytes = b"", value: int = 0, gas_limit: int = 1_000_000,
-            sload=None, sstore=None) -> ExecResult:
+            sload=None, sstore=None, static: bool = False,
+            call_host=None) -> ExecResult:
     """Run ``code`` to completion.
 
     sload(key_int) -> int and sstore(key_int, value_int) bridge contract
     storage to the chain KV; both default to an in-memory dict (pure
     eth_call-style simulation).
+
+    ``call_host(kind, to20, data, fwd_gas, value)`` services the
+    inter-contract CALL family (kind in "call"/"static"/"delegate");
+    it returns (success, returndata, gas_spent, inner_logs) and NEVER
+    raises. Absent a host, CALL-family opcodes fail cleanly (push 0).
+    ``static`` makes SSTORE/LOG* exceptional halts (STATICCALL frame).
 
     Raises EvmRevert (REVERT opcode, gas charged so far) or EvmError
     (exceptional halt, all gas consumed).
@@ -173,6 +185,7 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
     stack: list[int] = []
     logs: list[Log] = []
     dests = _valid_jumpdests(code)
+    returndata = b""               # last CALL-family return buffer
     pc = 0
 
     def push(v: int) -> None:
@@ -216,7 +229,7 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             return ExecResult(out, gas.used, logs)
         elif op == 0xFD:                            # REVERT
             off, size = pop(), pop()
-            raise EvmRevert(mem.read(off, size, gas))
+            raise EvmRevert(mem.read(off, size, gas), gas.used)
         # -- arithmetic ---------------------------------------------------
         elif op == 0x01:                            # ADD
             gas.use(G_VERYLOW); push(pop() + pop())
@@ -320,8 +333,15 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
                 mem._expand(doff + size, gas)
                 chunk = code[soff:soff + size] if soff < len(code) else b""
                 mem.write(doff, chunk.ljust(size, b"\0"), gas)
-        elif op == 0x3D:                            # RETURNDATASIZE (no
-            gas.use(G_BASE); push(0)                # inner calls: 0)
+        elif op == 0x3D:                            # RETURNDATASIZE
+            gas.use(G_BASE); push(len(returndata))
+        elif op == 0x3E:                            # RETURNDATACOPY
+            doff, soff, size = pop(), pop(), pop()
+            gas.use(G_VERYLOW + G_COPY_WORD * ((size + 31) // 32))
+            if soff + size > len(returndata):       # spec: exceptional
+                raise EvmError("returndatacopy out of bounds")
+            if size:
+                mem.write(doff, returndata[soff:soff + size], gas)
         # -- stack / memory / storage ------------------------------------
         elif op == 0x50:                            # POP
             gas.use(G_BASE); pop()
@@ -336,6 +356,8 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
         elif op == 0x54:                            # SLOAD
             gas.use(G_SLOAD); push(sload(pop()))
         elif op == 0x55:                            # SSTORE
+            if static:
+                raise EvmError("SSTORE in static context")
             k, v = pop(), pop()
             gas.use(G_SSTORE_SET if sload(k) == 0 and v != 0
                     else G_SSTORE_RESET)
@@ -361,6 +383,8 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             gas.use(1)
         # -- logs ---------------------------------------------------------
         elif 0xA0 <= op <= 0xA4:                    # LOG0..LOG4
+            if static:
+                raise EvmError("LOG in static context")
             ntopics = op - 0xA0
             off, size = pop(), pop()
             topics = tuple(pop().to_bytes(32, "big")
@@ -368,6 +392,34 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             gas.use(G_LOG + G_LOG_TOPIC * ntopics + G_LOG_DATA * size)
             logs.append(Log(address=address, topics=topics,
                             data=mem.read(off, size, gas)))
+        # -- inter-contract calls (serviced by call_host) -----------------
+        elif op in (0xF1, 0xF4, 0xFA):              # CALL/DELEGATECALL/
+            gas.use(G_CALL)                         # STATICCALL
+            gas_req, to = pop(), pop()
+            val = pop() if op == 0xF1 else 0
+            in_off, in_size = pop(), pop()
+            out_off, out_size = pop(), pop()
+            if static and val:
+                raise EvmError("value transfer in static context")
+            data = mem.read(in_off, in_size, gas)
+            if out_size:
+                mem._expand(out_off + out_size, gas)
+            # 63/64 forwarding rule bounds recursion cost
+            fwd = min(gas_req, gas.remaining - gas.remaining // 64)
+            kind = {0xF1: "call", 0xF4: "delegate", 0xFA: "static"}[op]
+            if call_host is None:
+                success, retdata, spent, inner_logs = 0, b"", 0, []
+            else:
+                success, retdata, spent, inner_logs = call_host(
+                    kind, to.to_bytes(32, "big")[-20:], data, fwd, val)
+            gas.use(min(spent, fwd))
+            returndata = retdata
+            if success:
+                logs.extend(inner_logs)
+            if out_size:
+                mem.write(out_off,
+                          retdata[:out_size].ljust(out_size, b"\0"), gas)
+            push(1 if success else 0)
         else:
             raise EvmError(f"invalid/unsupported opcode 0x{op:02x}")
     return ExecResult(b"", gas.used, logs)
@@ -401,7 +453,9 @@ OPS = {
     "SAR": 0x1D, "SHA3": 0x20, "ADDRESS": 0x30, "CALLER": 0x33,
     "CALLVALUE": 0x34, "CALLDATALOAD": 0x35, "CALLDATASIZE": 0x36,
     "CALLDATACOPY": 0x37, "CODESIZE": 0x38, "CODECOPY": 0x39,
-    "RETURNDATASIZE": 0x3D, "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52,
+    "RETURNDATASIZE": 0x3D, "RETURNDATACOPY": 0x3E,
+    "CALL": 0xF1, "DELEGATECALL": 0xF4, "STATICCALL": 0xFA,
+    "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52,
     "MSTORE8": 0x53, "SLOAD": 0x54, "SSTORE": 0x55, "JUMP": 0x56,
     "JUMPI": 0x57, "PC": 0x58, "MSIZE": 0x59, "GAS": 0x5A,
     "JUMPDEST": 0x5B, "LOG0": 0xA0, "LOG1": 0xA1, "LOG2": 0xA2,

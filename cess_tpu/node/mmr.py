@@ -133,17 +133,19 @@ def verify_proof(root: bytes, number: int, header_hash: bytes,
                  proof: MmrProof) -> bool:
     """Check a header's inclusion against an MMR root — pure function,
     no chain access (the light-client half)."""
-    if not isinstance(proof, MmrProof) \
-            or not isinstance(proof.leaf_count, int) \
-            or isinstance(proof.leaf_count, bool) \
-            or not 0 < proof.leaf_count < 1 << 63 \
-            or not isinstance(number, int) or isinstance(number, bool) \
-            or not 0 <= number < 1 << 63 \
-            or not isinstance(header_hash, bytes) \
-            or not all(isinstance(pk, bytes) for pk in
-                       tuple(proof.peaks_left) + tuple(proof.peaks_right)):
-        return False   # crafted inputs fail closed, never raise
-    try:
+    try:   # EVERY check inside: crafted inputs fail closed, never raise
+        if not isinstance(proof, MmrProof) \
+                or not isinstance(proof.leaf_count, int) \
+                or isinstance(proof.leaf_count, bool) \
+                or not 0 < proof.leaf_count < 1 << 63 \
+                or not isinstance(number, int) \
+                or isinstance(number, bool) \
+                or not 0 <= number < 1 << 63 \
+                or not isinstance(header_hash, bytes) \
+                or not all(isinstance(pk, bytes) for pk in
+                           tuple(proof.peaks_left)
+                           + tuple(proof.peaks_right)):
+            return False
         acc = leaf_hash(number, header_hash)
         for item in proof.path:
             if not (isinstance(item, tuple) and len(item) == 2

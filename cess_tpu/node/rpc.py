@@ -130,6 +130,18 @@ class RpcServer:
                                      ws_mod.accept_key(key))
                     self.end_headers()
                     self.close_connection = True
+                    # frames pipelined behind the upgrade were already
+                    # pulled into rfile's buffer; hand them to the WS
+                    # reader (read1 serves buffered bytes without a
+                    # blocking raw read — the 1 ms timeout covers the
+                    # empty-buffer case)
+                    import socket as _socket
+
+                    self.connection.settimeout(0.001)
+                    try:
+                        self.ws_initial = self.rfile.read1(65536) or b""
+                    except (_socket.timeout, OSError):
+                        self.ws_initial = b""
                     ws_mod.serve_connection(server, self)
                     return
                 if self.path != "/metrics":
@@ -602,18 +614,25 @@ class RpcServer:
         f["touched"] = _time.time()
         return f
 
-    def _filter_changes(self, node, rt, params):
-        """New matches since the last poll. Exactly-once on a stable
-        chain; across a reorg the cursor rewinds to the finalized
-        block (reorgs never cross finality) so events on the new
-        canonical branch are redelivered rather than silently lost —
-        at-least-once, never at-most-once."""
-        f = self._get_filter(params)
+    @staticmethod
+    def cursor_window(node, cursor: int, cursor_hash: bytes):
+        """Reorg-checked poll window shared by EthFilter polls and the
+        WS EthPubSub pusher: returns (since, head). A cursor whose
+        block hash vanished (reorg) rewinds to the finalized block —
+        reorgs never cross finality — so events on the new canonical
+        branch are redelivered rather than silently lost:
+        at-least-once across reorgs, exactly-once on a stable chain."""
         head = node.head()
-        since = f["cursor"]
-        if since > head.number \
-                or node.chain[since].hash() != f["cursor_hash"]:
-            since = min(node.finalized, head.number)
+        if cursor > head.number \
+                or node.chain[cursor].hash() != cursor_hash:
+            cursor = min(node.finalized, head.number)
+        return cursor, head
+
+    def _filter_changes(self, node, rt, params):
+        """New matches since the last poll (see cursor_window)."""
+        f = self._get_filter(params)
+        since, head = self.cursor_window(node, f["cursor"],
+                                         f["cursor_hash"])
         if f["type"] == "block":
             out = ["0x" + node.chain[n].hash().hex()
                    for n in range(since + 1, head.number + 1)]

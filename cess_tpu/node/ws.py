@@ -41,7 +41,23 @@ def accept_key(client_key: str) -> str:
         hashlib.sha1(client_key.encode() + _GUID).digest()).decode()
 
 
-def read_frame(sock: socket.socket) -> tuple[int, bytes] | None:
+class SockReader:
+    """recv() facade that drains a prefix buffer first — frames the
+    client PIPELINED behind the HTTP upgrade were already pulled into
+    the handler's buffered reader and must not be lost."""
+
+    def __init__(self, sock: socket.socket, initial: bytes = b""):
+        self.sock = sock
+        self.buf = initial
+
+    def recv(self, n: int) -> bytes:
+        if self.buf:
+            out, self.buf = self.buf[:n], self.buf[n:]
+            return out
+        return self.sock.recv(n)
+
+
+def read_frame(sock) -> tuple[int, bytes] | None:
     """One frame -> (opcode, payload); None on close/EOF; raises
     socket.timeout only while IDLE (before any header byte), so the
     caller's poll loop wakes without tearing the connection down.
@@ -74,7 +90,7 @@ def read_frame(sock: socket.socket) -> tuple[int, bytes] | None:
     return opcode, bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
 
 
-def _read_exact(sock: socket.socket, n: int,
+def _read_exact(sock, n: int,
                 idle_timeout_ok: bool = False) -> bytes | None:
     """n bytes or None. socket.timeout is an OSError subclass, so it
     needs explicit handling: with no bytes buffered and
@@ -119,6 +135,7 @@ def serve_connection(server, handler) -> None:
     http request handler whose socket we take over."""
     sock = handler.connection
     sock.settimeout(POLL_S)
+    reader = SockReader(sock, getattr(handler, "ws_initial", b""))
     subs: dict[str, dict] = {}     # id -> {kind, crit, cursor, hash}
 
     def send_raw(payload: bytes, opcode: int = 0x1) -> None:
@@ -144,7 +161,7 @@ def serve_connection(server, handler) -> None:
             return head.number, head.hash()
 
     try:
-        _serve(server, sock, subs, send_raw, send_json, snapshot_head)
+        _serve(server, reader, subs, send_raw, send_json, snapshot_head)
     except _Gone:
         return
 
@@ -231,12 +248,9 @@ def _push_updates(server, subs: dict, send_json) -> None:
 
     with server.lock:
         node = server.node
-        head = node.head()
         for sid, sub in subs.items():
-            since = sub["cursor"]
-            if since > head.number \
-                    or node.chain[since].hash() != sub["hash"]:
-                since = min(node.finalized, head.number)
+            since, head = server.cursor_window(node, sub["cursor"],
+                                               sub["hash"])
             if since >= head.number:
                 continue
             if sub["kind"] == "newHeads":

@@ -344,3 +344,108 @@ def test_eth_filter_criteria_semantics():
                        [{"fromBlock": 0,
                          "topics": [["0x" + word(b"\x01" * 20).hex()]]}])
     assert srv.handle("eth_getFilterLogs", [tmiss]) == []
+
+
+# -- inter-contract calls ------------------------------------------------------
+
+def _mk_caller(token_addr: bytes, op: str) -> bytes:
+    """A contract that forwards its calldata to the token via CALL /
+    STATICCALL / DELEGATECALL and returns (success_word, returndata)."""
+    return initcode(asm(
+        # copy our calldata to memory 0
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        # outOff=64 outSize=32, inOff=0 inSize=CALLDATASIZE
+        *( [32, 64, "CALLDATASIZE", 0]
+           + ([0] if op == "CALL" else [])
+           + [int.from_bytes(token_addr, "big"), 100_000, op] ),
+        # store success word at 32
+        32, "MSTORE",
+        # return mem[32:96] = [success, ret word]
+        64, 32, "RETURN",
+    ))
+
+
+def test_call_staticcall_between_contracts(rt):
+    token = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    bob_w = eth_address("bob")
+    for op, mutate in (("CALL", True), ("STATICCALL", False)):
+        proxy = rt.apply_extrinsic("dev", "evm.deploy",
+                                   _mk_caller(token, op))
+        # balanceOf through the proxy: success=1, inner return surfaces
+        out = rt.evm.query(proxy, calldata(2, eth_address("dev")),
+                           caller="dev")
+        assert int.from_bytes(out[:32], "big") == 1, op
+        assert int.from_bytes(out[32:64], "big") \
+            == (SUPPLY if op == "CALL" else SUPPLY)
+        if mutate:
+            # transfer THROUGH the proxy commits: but the token debits
+            # CALLER = the proxy (which has balance 0) -> inner revert
+            # -> success=0 while the proxy itself completes fine
+            out = rt.apply_extrinsic("dev", "evm.call", proxy,
+                                     calldata(1, bob_w, 10))
+            # (call() returns the proxy's output via dispatch result)
+            assert int.from_bytes(out[:32], "big") == 0
+            assert int.from_bytes(
+                rt.evm.query(token, calldata(2, bob_w)), "big") == 0
+        else:
+            # STATICCALL into a transfer = inner SSTORE violation ->
+            # success=0, and nothing committed
+            out = rt.apply_extrinsic("dev", "evm.call", proxy,
+                                     calldata(1, bob_w, 10))
+            assert int.from_bytes(out[:32], "big") == 0
+            assert int.from_bytes(
+                rt.evm.query(token, calldata(2, bob_w)), "big") == 0
+
+
+def test_delegatecall_uses_caller_storage(rt):
+    token = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    proxy = rt.apply_extrinsic("dev", "evm.deploy",
+                               _mk_caller(token, "DELEGATECALL"))
+    dev_w = eth_address("dev")
+    # through DELEGATECALL the token code reads the PROXY's storage:
+    # nothing was ever minted there, balance must be 0 (not SUPPLY)
+    out = rt.evm.query(proxy, calldata(2, dev_w), caller="dev")
+    assert int.from_bytes(out[:32], "big") == 1
+    assert int.from_bytes(out[32:64], "big") == 0
+    # and the token's own state is untouched
+    assert int.from_bytes(
+        rt.evm.query(token, calldata(2, dev_w)), "big") == SUPPLY
+
+
+def test_inner_revert_unwinds_only_inner_writes(rt):
+    token = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    # proxy that writes its own slot 7, then CALLs token.transfer
+    # (which reverts: proxy has no balance), then returns its slot 7
+    proxy_code = initcode(asm(
+        99, 7, "SSTORE",                       # own write BEFORE call
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        0, 0, "CALLDATASIZE", 0, 0,
+        int.from_bytes(token, "big"), 100_000, "CALL",
+        "POP",                                 # ignore success
+        7, "SLOAD", 0, "MSTORE", 32, 0, "RETURN",
+    ))
+    proxy = rt.apply_extrinsic("dev", "evm.deploy", proxy_code)
+    out = rt.apply_extrinsic("dev", "evm.call", proxy,
+                             calldata(1, eth_address("bob"), 5))
+    # outer write survives the inner revert
+    assert int.from_bytes(out, "big") == 99
+    assert rt.evm.storage_at(proxy, 7) == 99
+
+
+def test_query_with_inner_calls_never_writes_state(rt):
+    """Review finding (confirmed leak, now fixed): eth_call through a
+    proxy whose inner CALL succeeds must leave chain state untouched —
+    all writes, inner frames included, land in session overlays."""
+    token = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    proxy = rt.apply_extrinsic("dev", "evm.deploy",
+                               _mk_caller(token, "CALL"))
+    # fund the proxy inside the token so the simulated inner transfer
+    # SUCCEEDS (a reverting inner call would mask the leak)
+    rt.apply_extrinsic("dev", "evm.call", token, calldata(1, proxy, 500))
+    bob_w = eth_address("bob")
+    out = rt.evm.query(proxy, calldata(1, bob_w, 40), caller="dev")
+    assert int.from_bytes(out[:32], "big") == 1   # simulated success
+    assert int.from_bytes(
+        rt.evm.query(token, calldata(2, bob_w)), "big") == 0
+    assert int.from_bytes(
+        rt.evm.query(token, calldata(2, proxy)), "big") == 500
