@@ -59,6 +59,7 @@ G_STEP = 1
 G_SGET = 20
 G_SPUT = 200
 G_EMIT = 50
+G_XCALL = 700
 MAX_DEPTH = 32                   # nesting bound for constructed values
 
 
@@ -93,7 +94,11 @@ def _size_of(v) -> int:
 
 
 def _exec(code: tuple, *, input_tuple: tuple, caller: str,
-          gas_limit: int, sget, sput, emit) -> object:
+          gas_limit: int, sget, sput, emit, xcall=None) -> object:
+    """``xcall(addr, method, args, fwd_gas) -> (ok, value)`` services
+    cross-contract calls (never raises; the forwarded gas is consumed
+    in full by the op itself); absent a host, the op pushes a failure
+    tuple."""
     stack: list = []
     gas = gas_limit
     pc = 0
@@ -234,6 +239,26 @@ def _exec(code: tuple, *, input_tuple: tuple, caller: str,
             v = pop()
             use(G_EMIT + _size_of(v))
             emit(v)
+        elif op == "xcall":
+            # cross-contract call (pallet-contracts call-chain role):
+            # pops gas, args(tuple), method(str), address(bytes);
+            # pushes (1, result) on success, (0, reason) on failure —
+            # an inner revert/trap NEVER traps the caller
+            use(G_XCALL)
+            g, ar, m, a = pop(), pop(), pop(), pop()
+            if not (isinstance(g, int) and not isinstance(g, bool)
+                    and g > 0 and isinstance(ar, tuple)
+                    and isinstance(m, str) and isinstance(a, bytes)):
+                raise _Trap("xcall: (addr, method, args, gas) required")
+            # 63/64 forwarding; the forwarded budget is consumed in
+            # full (no refund) — a strict upper bound, kept simple
+            fwd = min(g, gas - gas // 64)
+            use(fwd)
+            if xcall is None:
+                push((0, "no host"))
+            else:
+                ok, val = xcall(a, m, ar, fwd)
+                push((1 if ok else 0, val))
         elif op == "return":
             return pop()
         elif op == "revert":
@@ -306,46 +331,94 @@ class Contracts:
         if not isinstance(method, str) or not isinstance(args, tuple):
             raise DispatchError("contracts.InvalidCall")
         gas_limit = self._check_gas(gas_limit)
-        overlay: dict[bytes, object] = {}
-
-        def sget(k):
-            kk = _storage_key(k)
-            if kk in overlay:
-                return overlay[kk]
-            return self.state.get(PALLET, "storage", address, kk)
-
+        # the root session is simply never committed: every frame's
+        # writes and events — inner xcalls included — are thrown away
         return self._run(caller, address, (method, *args), gas_limit,
-                         sget=sget,
-                         sput=lambda k, v: overlay.__setitem__(
-                             _storage_key(k), v),
-                         emit=lambda v: None)
+                         commit=False)
+
+    MAX_XCALL_DEPTH = 8
+
+    class _Session:
+        """One frame's view of contract storage + pending events: an
+        overlay chained over the parent frame's session (root falls
+        through to chain state). A successful frame commits into its
+        PARENT's session, so an intermediate frame's revert unwinds
+        its entire subtree — writes AND events (pallet-contracts
+        call-chain transactionality; review-confirmed that committing
+        to chain directly let a reverted frame's grandchildren
+        persist). The root commits to chain only when the top frame
+        succeeds; query() never commits its root."""
+
+        def __init__(self, contracts: "Contracts", parent=None):
+            self.c = contracts
+            self.parent = parent
+            self.over: dict[tuple[bytes, bytes], object] = {}
+            self.events: list[tuple[bytes, object]] = []
+
+        def get(self, a: bytes, k):
+            kk = _storage_key(k)
+            s = self
+            while s is not None:
+                if (a, kk) in s.over:
+                    return s.over[a, kk]
+                s = s.parent
+            return self.c.state.get(PALLET, "storage", a, kk)
+
+        def hooks(self, a: bytes):
+            return (lambda k: self.get(a, k),
+                    lambda k, v: self.over.__setitem__(
+                        (a, _storage_key(k)), v),
+                    lambda v: self.events.append((a, v)))
+
+        def commit(self) -> None:
+            if self.parent is not None:
+                self.parent.over.update(self.over)
+                self.parent.events.extend(self.events)
+            else:
+                for (a, kk), v in self.over.items():
+                    self.c.state.put(PALLET, "storage", a, kk, v)
+                for a, v in self.events:
+                    self.c.state.deposit_event(PALLET, "ContractEvent",
+                                               address=a, data=v)
 
     # -- engine bridge -------------------------------------------------------
     def _run(self, who: str, address: bytes, input_tuple: tuple,
-             gas_limit: int, sget=None, sput=None, emit=None):
-        """One exec bridge for call and query; query passes
-        overlay-backed storage hooks and a null emit."""
+             gas_limit: int, session: "Contracts._Session | None" = None,
+             depth: int = 0, commit: bool = True):
+        """One exec bridge for call, query, and recursive xcall frames
+        (see _Session for the commit discipline). ``commit=False``
+        (query) discards the root session."""
         code = self.code_at(address)
         if code is None:
             raise DispatchError("contracts.NoContract")
-        if sget is None:
-            def sget(k):
-                return self.state.get(PALLET, "storage", address,
-                                      _storage_key(k))
-        if sput is None:
-            def sput(k, v) -> None:
-                self.state.put(PALLET, "storage", address,
-                               _storage_key(k), v)
-        if emit is None:
-            def emit(v) -> None:
-                self.state.deposit_event(PALLET, "ContractEvent",
-                                         address=address, data=v)
+        if session is None:
+            session = Contracts._Session(self)
+        sget, sput, emit = session.hooks(address)
+
+        def xcall(a: bytes, method: str, args: tuple, fwd: int):
+            if depth >= self.MAX_XCALL_DEPTH:
+                return 0, "call depth exceeded"
+            if self.code_at(a) is None:
+                return 0, "no contract"
+            child = Contracts._Session(self, parent=session)
+            try:
+                out = self._run(
+                    # the CALLER of the inner frame is this contract
+                    "contract:" + address.hex(), a, (method, *args),
+                    fwd, session=child, depth=depth + 1, commit=False)
+            except DispatchError as e:
+                return 0, str(e)
+            child.commit()             # into the PARENT frame's session
+            return 1, out
 
         try:
-            return _exec(code, input_tuple=input_tuple, caller=who,
-                         gas_limit=gas_limit, sget=sget, sput=sput,
-                         emit=emit)
+            out = _exec(code, input_tuple=input_tuple, caller=who,
+                        gas_limit=gas_limit, sget=sget, sput=sput,
+                        emit=emit, xcall=xcall)
         except _Revert as e:
             raise DispatchError("contracts.Reverted", repr(e.value)) from e
         except _Trap as e:
             raise DispatchError("contracts.Trapped", str(e)) from e
+        if commit and session.parent is None:
+            session.commit()
+        return out

@@ -124,22 +124,50 @@ class Evm:
 
     MAX_CALL_DEPTH = 8
 
-    def _host(self, frame_addr: bytes, frame_caller: bytes, static: bool,
-              depth: int, sload, sstore, storage_for=None):
-        """call_host closure for one frame: services the CALL family
-        recursively. Inner frames run against a private overlay that
-        commits to the parent's storage hooks ONLY on success, so an
-        inner revert/halt unwinds its writes while the outer frame
-        continues (pallet-evm subcall semantics). ``storage_for(addr)``
-        supplies the base (load, store) hooks for a target address —
-        chain state for dispatched calls, a per-address session
-        overlay for query() so eth_call can NEVER write real state.
-        Value transfer is out of scope (value != 0 fails the call),
-        depth is capped."""
-        if storage_for is None:
-            def storage_for(a):
-                return self._sload(a), self._sstore(a)
+    class _World:
+        """One frame's view of ALL contract storage: an overlay over
+        the parent frame's world (root falls through to chain state).
+        A frame that succeeds commits into its PARENT's overlay — so
+        when an intermediate frame later reverts, its whole subtree's
+        writes vanish with it (pallet-evm call-chain transactionality,
+        review-confirmed: committing to chain directly let a reverted
+        frame's grandchildren persist). Chained loads also give
+        re-entered frames a consistent view of ancestors' pending
+        writes. The root commits to chain only when the TOP frame
+        succeeds; query() simply never commits its root."""
 
+        def __init__(self, evm: "Evm", parent=None):
+            self.evm = evm
+            self.parent = parent
+            self.over: dict[tuple[bytes, int], int] = {}
+
+        def load(self, a: bytes, k: int) -> int:
+            w = self
+            while w is not None:
+                if (a, k) in w.over:
+                    return w.over[a, k]
+                w = w.parent
+            return self.evm._sload(a)(k)
+
+        def store(self, a: bytes, k: int, v: int) -> None:
+            self.over[a, k] = v
+
+        def hooks(self, a: bytes):
+            return (lambda k: self.load(a, k),
+                    lambda k, v: self.store(a, k, v))
+
+        def commit(self) -> None:
+            if self.parent is not None:
+                self.parent.over.update(self.over)
+            else:
+                for (a, k), v in self.over.items():
+                    self.evm._sstore(a)(k, v)
+
+    def _host(self, frame_addr: bytes, frame_caller: bytes, static: bool,
+              depth: int, world: "Evm._World"):
+        """call_host closure for one frame (see _World for the commit
+        discipline). Value transfer is out of scope (value != 0 fails
+        the call), depth is capped."""
         def call_host(kind, to, data, fwd_gas, value):
             if depth >= self.MAX_CALL_DEPTH or value != 0:
                 return 0, b"", 0, []
@@ -147,33 +175,26 @@ class Evm:
             if code is None:
                 return 1, b"", 0, []    # empty account: success, no-op
             if kind == "delegate":      # callee code, CALLER storage
-                base_load, base_store = sload, sstore
                 inner_addr, inner_caller = frame_addr, frame_caller
             else:
-                base_load, base_store = storage_for(to)
                 inner_addr, inner_caller = to, frame_addr
             inner_static = static or kind == "static"
-            overlay: dict[int, int] = {}
-
-            def o_load(k: int) -> int:
-                return overlay[k] if k in overlay else base_load(k)
-
+            child = Evm._World(self, parent=world)
+            sload, sstore = child.hooks(inner_addr)
             try:
                 res = evm_interp.execute(
                     code, calldata=data, caller=inner_caller,
                     address=inner_addr, gas_limit=fwd_gas,
-                    sload=o_load, sstore=overlay.__setitem__,
+                    sload=sload, sstore=sstore,
                     static=inner_static,
                     call_host=self._host(inner_addr, inner_caller,
                                          inner_static, depth + 1,
-                                         o_load, overlay.__setitem__,
-                                         storage_for))
+                                         child))
             except EvmRevert as e:
                 return 0, e.data, e.gas_used, []
             except EvmError:
                 return 0, b"", fwd_gas, []
-            for k, v in overlay.items():
-                base_store(k, v)        # commit on success only
+            child.commit()              # into the PARENT frame's world
             return 1, res.output, res.gas_used, res.logs
         return call_host
 
@@ -188,18 +209,19 @@ class Evm:
             raise DispatchError("evm.InvalidCall")
         gas_limit = self._check_gas(gas_limit)
         caller = eth_address(who)
-        sload, sstore = self._sload(address), self._sstore(address)
+        world = Evm._World(self)           # root: commits to chain
+        sload, sstore = world.hooks(address)
         try:
             res = evm_interp.execute(
                 code, calldata=calldata, caller=caller,
                 address=address, gas_limit=gas_limit,
                 sload=sload, sstore=sstore,
-                call_host=self._host(address, caller, False, 0,
-                                     sload, sstore))
+                call_host=self._host(address, caller, False, 0, world))
         except EvmRevert as e:
             raise DispatchError("evm.Reverted", e.data.hex()) from e
         except EvmError as e:
             raise DispatchError("evm.ExecutionFailed", str(e)) from e
+        world.commit()
         self._archive_logs(res.logs)
         self.state.deposit_event(PALLET, "Called", who=who,
                                  address=address, out_len=len(res.output),
@@ -217,21 +239,10 @@ class Evm:
         if not isinstance(calldata, bytes):
             raise DispatchError("evm.InvalidCall")
         gas_limit = self._check_gas(gas_limit)
-        # per-address session overlays: every write in this simulation
-        # — including writes by INNER calls to other contracts — lands
-        # here and is thrown away; chain state is read-only underneath
-        session: dict[bytes, dict[int, int]] = {}
-
-        def storage_for(a: bytes):
-            ov = session.setdefault(a, {})
-            base = self._sload(a)
-
-            def load(k: int) -> int:
-                return ov[k] if k in ov else base(k)
-
-            return load, ov.__setitem__
-
-        sload, sstore = storage_for(address)
+        # a root world that is NEVER committed: every write in this
+        # simulation — inner frames included — is thrown away
+        world = Evm._World(self)
+        sload, sstore = world.hooks(address)
         caller_w = eth_address(caller)
         try:
             res = evm_interp.execute(
@@ -239,7 +250,7 @@ class Evm:
                 address=address, gas_limit=gas_limit,
                 sload=sload, sstore=sstore,
                 call_host=self._host(address, caller_w, False, 0,
-                                     sload, sstore, storage_for))
+                                     world))
         except EvmRevert as e:
             raise DispatchError("evm.Reverted", e.data.hex()) from e
         except EvmError as e:

@@ -276,6 +276,16 @@ class RpcServer:
             return rt.sminer.miner(params[0])
         if method == "cess_fileInfo":
             return rt.file_bank.file(_decode(params[0]))
+        if method == "cess_teeVerdicts":
+            # the BLS-sealed verdict log plus each TEE's on-chain
+            # pubkey: everything an external auditor needs to re-run
+            # audit.reverify_verdict offline (public verifiability)
+            recs = rt.audit.verdicts()
+            keys = {}
+            for t in sorted({r.tee for r in recs}):
+                w = rt.tee_worker.worker(t)
+                keys[t] = w.bls_pk if w is not None else b""
+            return {"verdicts": list(recs), "blsKeys": keys}
         if method == "cess_challenge":
             return rt.audit.challenge()
         if method == "system_version":

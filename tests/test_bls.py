@@ -259,3 +259,26 @@ def test_pure_python_fallback_agrees(monkeypatch):
     assert bls.sign(sk2, b"fallback msg") == sig
     assert bls.verify(pk, b"fallback msg", sig)
     assert not bls.verify(pk, b"fallback msh", sig)
+
+
+def test_rpc_verdict_log_is_publicly_reverifiable():
+    """cess_teeVerdicts hands an external auditor the sealed log plus
+    the pubkeys — re-verification needs nothing else."""
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    rt, sk, pk = _setup()
+    mission = _queue_mission(rt, "tee1")
+    digest = audit_mod.mission_digest(mission)
+    sig = bls.sign(sk, audit_mod.verdict_message("tee1", digest, True,
+                                                 True))
+    rt.apply_extrinsic("tee1", "audit.submit_verify_result", "m1", True,
+                       True, sig)
+    spec = dev_spec()
+    node = Node(spec, "vrpc", {})
+    node.runtime = rt               # serve the prepared runtime
+    srv = RpcServer(node, port=0)
+    out = srv.handle("cess_teeVerdicts", [])
+    (rec,) = out["verdicts"]
+    assert reverify_verdict(rec, out["blsKeys"]["tee1"])

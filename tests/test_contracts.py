@@ -160,3 +160,119 @@ def test_emit_flood_is_gas_bounded(rt):
               if e.name == "ContractEvent"]
     emitted = sum(len(dict(e.data)["data"]) for e in events)
     assert emitted <= GAS_CAP, "event bytes must be bounded by gas spent"
+
+
+# -- cross-contract calls (pallet-contracts call-chain role) -------------------
+
+# a "vault" that stores deposits under the CALLER's identity
+VAULT = (
+    ("input",), ("push", 0), ("index",),            # method
+    ("dup", 0), ("push", "put"), ("eq",), ("jumpi", 9),
+    ("push", "bad"), ("revert",),
+    # 9: put -> storage[caller] = input[1]; emits; returns 7
+    ("caller",), ("input",), ("push", 1), ("index",), ("sput",),
+    ("push", "stored"), ("emit",),
+    ("push", 7), ("return",),
+)
+
+
+def _proxy(vault_addr: bytes) -> tuple:
+    """forwards ("fwd", x) -> vault.put(x) via xcall; stores its own
+    marker FIRST so revert isolation is observable; returns the
+    (ok, value) tuple from the call."""
+    return (
+        ("push", "mark"), ("push", 1), ("sput",),   # own write
+        ("push", vault_addr), ("push", "put"),
+        ("input",), ("push", 1), ("index",), ("tuple", 1),
+        ("push", 100_000), ("xcall",),
+        ("return",),
+    )
+
+
+def test_xcall_roundtrip_and_caller_identity(rt):
+    vault = rt.apply_extrinsic("dev", "contracts.deploy", VAULT)
+    proxy = rt.apply_extrinsic("dev", "contracts.deploy", _proxy(vault))
+    ok, val = rt.apply_extrinsic("dev", "contracts.call", proxy, "fwd",
+                                 (41,))
+    assert (ok, val) == (1, 7)
+    # the vault stored under the PROXY's contract identity, not "dev"
+    from cess_tpu.chain.contracts import _storage_key
+    stored = rt.state.get("contracts", "storage", vault,
+                          _storage_key("contract:" + proxy.hex()))
+    assert stored == 41
+    # inner events committed with the outer dispatch
+    assert any(e.name == "ContractEvent" and dict(e.data)["data"] == "stored"
+               for e in rt.state.events)
+
+
+def test_xcall_inner_revert_isolated(rt):
+    vault = rt.apply_extrinsic("dev", "contracts.deploy", VAULT)
+    proxy = rt.apply_extrinsic("dev", "contracts.deploy", _proxy(vault))
+    # unknown method reverts INSIDE the vault: proxy still completes,
+    # gets (0, reason), and its own pre-call write survives
+    bad_proxy = rt.apply_extrinsic("dev", "contracts.deploy", (
+        ("push", "mark"), ("push", 1), ("sput",),
+        ("push", vault), ("push", "nosuch"), ("tuple", 0),
+        ("push", 100_000), ("xcall",),
+        ("return",),
+    ))
+    ok, _reason = rt.apply_extrinsic("dev", "contracts.call", bad_proxy,
+                                     "x")
+    assert ok == 0
+    from cess_tpu.chain.contracts import _storage_key
+    # sput pops value-then-key: ("push","mark")("push",1) -> mark := 1
+    assert rt.state.get("contracts", "storage", bad_proxy,
+                        _storage_key("mark")) == 1
+    # nothing landed in the vault
+    assert not list(rt.state.iter_prefix("contracts", "storage", vault))
+
+
+def test_xcall_depth_cap_and_query_isolation(rt):
+    vault = rt.apply_extrinsic("dev", "contracts.deploy", VAULT)
+    # chain of proxies 12 deep ending at the vault
+    addrs = [vault]
+    for _ in range(12):
+        addrs.append(rt.apply_extrinsic("dev", "contracts.deploy",
+                                        _proxy(addrs[-1])))
+    res = rt.apply_extrinsic("dev", "contracts.call", addrs[-1],
+                             "fwd", (9,), 2_000_000)
+    # each hop wraps (ok, inner): the chain must terminate by
+    # BOTTOMING OUT in a depth-cap failure, not by reaching the vault
+    depth_failed = False
+    cur = res
+    while isinstance(cur, tuple) and len(cur) == 2:
+        ok, cur = cur
+        if ok == 0:
+            depth_failed = True
+            break
+    assert depth_failed
+    # query through a proxy whose inner call WRITES must not touch state
+    proxy = rt.apply_extrinsic("dev", "contracts.deploy", _proxy(vault))
+    ok, val = rt.contracts.query(proxy, "fwd", (5,))
+    assert (ok, val) == (1, 7)
+    assert not list(rt.state.iter_prefix("contracts", "storage", vault))
+
+
+def test_middle_frame_revert_unwinds_grandchild_writes(rt):
+    """Review-confirmed flaw (now fixed): A -> B -> C where C succeeds
+    and writes, then B reverts — C's writes and events must vanish
+    with B's frame, not persist on chain."""
+    vault = rt.apply_extrinsic("dev", "contracts.deploy", VAULT)
+    # B: xcalls the vault (C, which WRITES + EMITS), then reverts
+    b = rt.apply_extrinsic("dev", "contracts.deploy", (
+        ("push", vault), ("push", "put"),
+        ("push", 5), ("tuple", 1),
+        ("push", 100_000), ("xcall",), ("pop",),
+        ("push", "after-child"), ("revert",),
+    ))
+    # A: xcalls B, survives B's revert, returns B's failure tuple
+    a = rt.apply_extrinsic("dev", "contracts.deploy", (
+        ("push", b), ("push", "go"), ("tuple", 0),
+        ("push", 500_000), ("xcall",), ("return",),
+    ))
+    ok, _reason = rt.apply_extrinsic("dev", "contracts.call", a, "x")
+    assert ok == 0                       # B reverted
+    # C's write died with B's frame...
+    assert not list(rt.state.iter_prefix("contracts", "storage", vault))
+    # ...and so did C's event
+    assert not any(e.name == "ContractEvent" for e in rt.state.events)

@@ -449,3 +449,37 @@ def test_query_with_inner_calls_never_writes_state(rt):
         rt.evm.query(token, calldata(2, bob_w)), "big") == 0
     assert int.from_bytes(
         rt.evm.query(token, calldata(2, proxy)), "big") == 500
+
+
+def test_middle_frame_revert_unwinds_grandchild_writes(rt):
+    """Review-confirmed flaw (now fixed): A -> B -> token where the
+    token transfer SUCCEEDS, then B reverts — the token's storage
+    write must vanish with B's frame."""
+    token = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    bob_w = eth_address("bob")
+    # B: forward calldata to the token, then REVERT unconditionally
+    b_code = initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        0, 0, "CALLDATASIZE", 0, 0,
+        int.from_bytes(token, "big"), 200_000, "CALL",
+        "POP", 0, 0, "REVERT",
+    ))
+    b = rt.apply_extrinsic("dev", "evm.deploy", b_code)
+    # fund B inside the token so its inner transfer SUCCEEDS
+    rt.apply_extrinsic("dev", "evm.call", token, calldata(1, b, 500))
+    # A: call B, IGNORE its failure, return cleanly
+    a_code = initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        0, 0, "CALLDATASIZE", 0, 0,
+        int.from_bytes(b, "big"), 300_000, "CALL",
+        0, "MSTORE", 32, 0, "RETURN",
+    ))
+    a = rt.apply_extrinsic("dev", "evm.deploy", a_code)
+    out = rt.apply_extrinsic("dev", "evm.call", a,
+                             calldata(1, bob_w, 40))
+    assert int.from_bytes(out, "big") == 0        # B reverted
+    # the token transfer B's frame contained was unwound with it
+    assert int.from_bytes(
+        rt.evm.query(token, calldata(2, bob_w)), "big") == 0
+    assert int.from_bytes(
+        rt.evm.query(token, calldata(2, b)), "big") == 500
