@@ -118,6 +118,49 @@ def reverify_verdict(record: VerdictRecord, bls_pk: bytes) -> bool:
         record.bls_sig)
 
 
+def reverify_verdicts_batch(records, bls_keys: dict) -> bool:
+    """Audit the WHOLE sealed log in one pairing product (the
+    cess_teeVerdicts RPC output feeds straight in): ~N times cheaper
+    than per-record verification for an external auditor. Duplicate
+    messages are handled — exact duplicates collapse into one check,
+    and message collisions with differing signatures verify
+    individually (deterministic BLS: at most one can be valid) — so a
+    False ALWAYS means some record is forged; the caller locates it
+    with per-record reverify_verdict."""
+    seen: dict[bytes, bytes] = {}      # message -> signature
+    uniq: list[VerdictRecord] = []
+    singles: list[VerdictRecord] = []
+    for r in records:
+        msg = verdict_message(r.tee, r.mission_digest, r.idle_ok,
+                              r.service_ok)
+        if msg not in seen:
+            seen[msg] = r.bls_sig
+            uniq.append(r)
+        elif seen[msg] != r.bls_sig:
+            # same message, different signature: BLS signatures are
+            # deterministic, so at most one can be valid — check these
+            # individually instead of poisoning the aggregate
+            singles.append(r)
+        # exact duplicates: one aggregated check covers both
+    for r in singles:
+        if not reverify_verdict(r, bls_keys.get(r.tee, b"")):
+            return False
+    if not uniq:
+        return True
+    try:
+        agg = bls12381.aggregate([r.bls_sig for r in uniq])
+    except ValueError:
+        return False
+    pairs = []
+    for r in uniq:
+        pk = bls_keys.get(r.tee)
+        if not pk:
+            return False
+        pairs.append((pk, verdict_message(r.tee, r.mission_digest,
+                                          r.idle_ok, r.service_ok)))
+    return bls12381.aggregate_verify(pairs, agg)
+
+
 class Audit:
     def __init__(self, state: State, sminer: Sminer, tee_worker=None,
                  storage_handler=None, file_bank=None,

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import hashlib
 
+from .overlay import ChainedOverlay
 from .state import DispatchError, State
 
 PALLET = "contracts"
@@ -338,45 +339,33 @@ class Contracts:
 
     MAX_XCALL_DEPTH = 8
 
-    class _Session:
-        """One frame's view of contract storage + pending events: an
-        overlay chained over the parent frame's session (root falls
-        through to chain state). A successful frame commits into its
-        PARENT's session, so an intermediate frame's revert unwinds
-        its entire subtree — writes AND events (pallet-contracts
-        call-chain transactionality; review-confirmed that committing
-        to chain directly let a reverted frame's grandchildren
-        persist). The root commits to chain only when the top frame
-        succeeds; query() never commits its root."""
+    class _Session(ChainedOverlay):
+        """Frame-chained contract storage (keys are
+        (address, hashed-slot)) PLUS pending events — events follow
+        the same discipline as writes, so a reverted subtree's events
+        vanish with it. See chain/overlay.py (shared with the EVM)."""
 
         def __init__(self, contracts: "Contracts", parent=None):
+            st = contracts.state
+            super().__init__(
+                root_get=lambda ak: st.get(PALLET, "storage", ak[0],
+                                           ak[1]),
+                root_put=lambda ak, v: st.put(PALLET, "storage", ak[0],
+                                              ak[1], v),
+                parent=parent)
             self.c = contracts
-            self.parent = parent
-            self.over: dict[tuple[bytes, bytes], object] = {}
             self.events: list[tuple[bytes, object]] = []
 
-        def get(self, a: bytes, k):
-            kk = _storage_key(k)
-            s = self
-            while s is not None:
-                if (a, kk) in s.over:
-                    return s.over[a, kk]
-                s = s.parent
-            return self.c.state.get(PALLET, "storage", a, kk)
-
         def hooks(self, a: bytes):
-            return (lambda k: self.get(a, k),
-                    lambda k, v: self.over.__setitem__(
-                        (a, _storage_key(k)), v),
+            return (lambda k: self.get((a, _storage_key(k))),
+                    lambda k, v: self.put((a, _storage_key(k)), v),
                     lambda v: self.events.append((a, v)))
 
         def commit(self) -> None:
+            super().commit()
             if self.parent is not None:
-                self.parent.over.update(self.over)
                 self.parent.events.extend(self.events)
             else:
-                for (a, kk), v in self.over.items():
-                    self.c.state.put(PALLET, "storage", a, kk, v)
                 for a, v in self.events:
                     self.c.state.deposit_event(PALLET, "ContractEvent",
                                                address=a, data=v)

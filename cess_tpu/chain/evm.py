@@ -25,6 +25,7 @@ import hashlib
 
 from . import evm_interp
 from .evm_interp import EvmError, EvmRevert
+from .overlay import ChainedOverlay
 from .state import DispatchError, State
 
 PALLET = "evm"
@@ -124,44 +125,21 @@ class Evm:
 
     MAX_CALL_DEPTH = 8
 
-    class _World:
-        """One frame's view of ALL contract storage: an overlay over
-        the parent frame's world (root falls through to chain state).
-        A frame that succeeds commits into its PARENT's overlay — so
-        when an intermediate frame later reverts, its whole subtree's
-        writes vanish with it (pallet-evm call-chain transactionality,
-        review-confirmed: committing to chain directly let a reverted
-        frame's grandchildren persist). Chained loads also give
-        re-entered frames a consistent view of ancestors' pending
-        writes. The root commits to chain only when the TOP frame
-        succeeds; query() simply never commits its root."""
+    class _World(ChainedOverlay):
+        """Frame-chained view of ALL contract storage, keyed by
+        (address, slot) — see chain/overlay.py for the commit
+        discipline shared with the contracts VM."""
 
         def __init__(self, evm: "Evm", parent=None):
+            super().__init__(
+                root_get=lambda ak: evm._sload(ak[0])(ak[1]),
+                root_put=lambda ak, v: evm._sstore(ak[0])(ak[1], v),
+                parent=parent)
             self.evm = evm
-            self.parent = parent
-            self.over: dict[tuple[bytes, int], int] = {}
-
-        def load(self, a: bytes, k: int) -> int:
-            w = self
-            while w is not None:
-                if (a, k) in w.over:
-                    return w.over[a, k]
-                w = w.parent
-            return self.evm._sload(a)(k)
-
-        def store(self, a: bytes, k: int, v: int) -> None:
-            self.over[a, k] = v
 
         def hooks(self, a: bytes):
-            return (lambda k: self.load(a, k),
-                    lambda k, v: self.store(a, k, v))
-
-        def commit(self) -> None:
-            if self.parent is not None:
-                self.parent.over.update(self.over)
-            else:
-                for (a, k), v in self.over.items():
-                    self.evm._sstore(a)(k, v)
+            return (lambda k: self.get((a, k)),
+                    lambda k, v: self.put((a, k), v))
 
     def _host(self, frame_addr: bytes, frame_caller: bytes, static: bool,
               depth: int, world: "Evm._World"):

@@ -106,8 +106,14 @@ class TeeWorker:
                                  controller=controller)
 
     def exit(self, controller: str) -> None:
-        if not self.state.contains(PALLET, "worker", controller):
+        w = self.worker(controller)
+        if w is None:
             raise DispatchError("tee_worker.NonTeeWorker")
+        if w.bls_pk:
+            # preserve the verdict-signing key: sealed verdicts in the
+            # audit log must stay publicly verifiable AFTER the worker
+            # leaves (an exited TEE must not launder its history)
+            self.state.put(PALLET, "retired_bls", controller, w.bls_pk)
         self.state.delete(PALLET, "worker", controller)
         self.state.deposit_event(PALLET, "ExitTeeWorker",
                                  controller=controller)
@@ -118,6 +124,15 @@ class TeeWorker:
 
     def tee_podr2_pk(self) -> bytes | None:
         return self.state.get(PALLET, "podr2_pk")
+
+    def bls_key_of(self, controller: str) -> bytes:
+        """The controller's verdict-signing key, live or retired —
+        what verdict re-verification must use."""
+        w = self.worker(controller)
+        if w is not None and w.bls_pk:
+            return w.bls_pk
+        return self.state.get(PALLET, "retired_bls", controller,
+                              default=b"")
 
     # -- ScheduleFind trait (lib.rs:287-321) -------------------------------------
     def controller_list(self) -> tuple[str, ...]:

@@ -281,10 +281,10 @@ class RpcServer:
             # pubkey: everything an external auditor needs to re-run
             # audit.reverify_verdict offline (public verifiability)
             recs = rt.audit.verdicts()
-            keys = {}
-            for t in sorted({r.tee for r in recs}):
-                w = rt.tee_worker.worker(t)
-                keys[t] = w.bls_pk if w is not None else b""
+            # bls_key_of falls back to the retired-key registry, so an
+            # exited TEE's sealed history stays verifiable
+            keys = {t: rt.tee_worker.bls_key_of(t)
+                    for t in sorted({r.tee for r in recs})}
             return {"verdicts": list(recs), "blsKeys": keys}
         if method == "cess_challenge":
             return rt.audit.challenge()

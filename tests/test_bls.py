@@ -282,3 +282,64 @@ def test_rpc_verdict_log_is_publicly_reverifiable():
     out = srv.handle("cess_teeVerdicts", [])
     (rec,) = out["verdicts"]
     assert reverify_verdict(rec, out["blsKeys"]["tee1"])
+
+
+def test_batch_reverification_of_verdict_log():
+    """One pairing product audits the whole sealed log; a single
+    tampered record fails the batch (distinct messages guaranteed by
+    the per-mission digests)."""
+    import time
+
+    from cess_tpu.chain.audit import reverify_verdicts_batch
+
+    rt, sk, pk = _setup()
+    recs = []
+    for i, miner in enumerate(("ma", "mb", "mc")):
+        mission = _queue_mission(rt, "tee1", miner=miner)
+        digest = audit_mod.mission_digest(mission)
+        sig = bls.sign(sk, audit_mod.verdict_message("tee1", digest,
+                                                     True, True))
+        rt.apply_extrinsic("tee1", "audit.submit_verify_result", miner,
+                           True, True, sig)
+    recs = rt.audit.verdicts()
+    assert len(recs) == 3
+    keys = {"tee1": pk}
+    assert reverify_verdicts_batch(recs, keys)
+    # tampering any record breaks the whole batch
+    import dataclasses
+    bad = list(recs)
+    bad[1] = dataclasses.replace(bad[1], idle_ok=False)
+    assert not reverify_verdicts_batch(bad, keys)
+    # unknown TEE key -> fail closed
+    assert not reverify_verdicts_batch(recs, {})
+    assert reverify_verdicts_batch([], {})
+    # EXACT duplicate records collapse into one check (valid log)
+    assert reverify_verdicts_batch(list(recs) + [recs[0]], keys)
+    # message collision with a DIFFERENT (forged) signature is caught
+    forged = dataclasses.replace(recs[0], bls_sig=recs[1].bls_sig)
+    assert not reverify_verdicts_batch(list(recs) + [forged], keys)
+
+
+def test_exited_tee_verdicts_stay_verifiable():
+    """Review finding (fixed): a TEE that seals verdicts and then
+    exits must not strand its history — the retired key registry keeps
+    the sealed log publicly verifiable."""
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    rt, sk, pk = _setup()
+    mission = _queue_mission(rt, "tee1")
+    sig = bls.sign(sk, audit_mod.verdict_message(
+        "tee1", audit_mod.mission_digest(mission), True, True))
+    rt.apply_extrinsic("tee1", "audit.submit_verify_result", "m1", True,
+                       True, sig)
+    rt.apply_extrinsic("tee1", "tee_worker.exit")
+    assert rt.tee_worker.worker("tee1") is None
+    assert rt.tee_worker.bls_key_of("tee1") == pk
+    node = Node(dev_spec(), "xr", {})
+    node.runtime = rt
+    out = RpcServer(node, port=0).handle("cess_teeVerdicts", [])
+    assert out["blsKeys"]["tee1"] == pk
+    (rec,) = out["verdicts"]
+    assert reverify_verdict(rec, out["blsKeys"]["tee1"])
