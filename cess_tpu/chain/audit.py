@@ -96,6 +96,11 @@ class VerdictRecord:
     idle_ok: bool
     service_ok: bool
     bls_sig: bytes              # 48-byte G1 signature ("" = legacy worker)
+    # the key that sealed this record, stamped by the chain at accept
+    # time (the worker's then-current registered key): verification
+    # survives the TEE exiting and re-registering with a NEW key, as
+    # long as the stamp is in tee_worker.bls_keys_of's trusted set
+    bls_pk: bytes = b""
 
 
 def verdict_message(tee: str, mission_digest: bytes, idle_ok: bool,
@@ -127,6 +132,23 @@ def reverify_verdicts_batch(records, bls_keys: dict) -> bool:
     individually (deterministic BLS: at most one can be valid) — so a
     False ALWAYS means some record is forged; the caller locates it
     with per-record reverify_verdict."""
+    def key_for(r) -> bytes | None:
+        """The key this record verifies under: its stamped sealing key
+        when it belongs to the TEE's trusted set (bls_keys values may
+        be one key or the full era history), else the newest key."""
+        allowed = bls_keys.get(r.tee)
+        if allowed is None:
+            return None
+        if isinstance(allowed, (bytes, bytearray)):
+            allowed = (bytes(allowed),)
+        else:
+            allowed = tuple(allowed)
+        if not allowed:
+            return None
+        if r.bls_pk:
+            return r.bls_pk if r.bls_pk in allowed else None
+        return allowed[-1]
+
     seen: dict[bytes, bytes] = {}      # message -> signature
     uniq: list[VerdictRecord] = []
     singles: list[VerdictRecord] = []
@@ -143,7 +165,8 @@ def reverify_verdicts_batch(records, bls_keys: dict) -> bool:
             singles.append(r)
         # exact duplicates: one aggregated check covers both
     for r in singles:
-        if not reverify_verdict(r, bls_keys.get(r.tee, b"")):
+        pk = key_for(r)
+        if pk is None or not reverify_verdict(r, pk):
             return False
     if not uniq:
         return True
@@ -153,8 +176,8 @@ def reverify_verdicts_batch(records, bls_keys: dict) -> bool:
         return False
     pairs = []
     for r in uniq:
-        pk = bls_keys.get(r.tee)
-        if not pk:
+        pk = key_for(r)
+        if pk is None:
             return False
         pairs.append((pk, verdict_message(r.tee, r.mission_digest,
                                           r.idle_ok, r.service_ok)))
@@ -355,7 +378,8 @@ class Audit:
             log = self.state.get(PALLET, "verdicts", default=())
             log += (VerdictRecord(tee=tee, miner=miner,
                                   mission_digest=digest, idle_ok=idle_ok,
-                                  service_ok=service_ok, bls_sig=bls_sig),)
+                                  service_ok=service_ok, bls_sig=bls_sig,
+                                  bls_pk=worker.bls_pk),)
             self.state.put(PALLET, "verdicts", log[-VERDICT_LOG_MAX:])
         rest = tuple(p for p in missions if p.miner != miner)
         if rest:

@@ -53,6 +53,7 @@ COUNCIL_CALLS = {
     "treasury.approve_bounty",
     "treasury.award_bounty",
     "treasury.close_bounty",
+    "treasury.assign_curator",
     "council.set_members",
     "system.retire_sudo",
     "system.apply_runtime_upgrade",
@@ -339,24 +340,125 @@ class Treasury:
         b = self.bounty(bid)
         if b is None or b[4] != "active":
             raise DispatchError("treasury.NoBounty", str(bid))
+        if self._active_children(bid):
+            raise DispatchError("treasury.HasActiveChildBounty", str(bid))
         _, _, value, _, _ = b
-        self.state.delete(TREASURY_PALLET, "bounty", bid)
-        approved = self.state.get(TREASURY_PALLET, "approved", default=())
-        self.state.put(TREASURY_PALLET, "approved",
-                       approved + ((beneficiary, value),))
+        # children carved value out of the parent; award the remainder
+        value -= self.state.get(TREASURY_PALLET, "children_value", bid,
+                                default=0)
+        self._clear_bounty_state(bid)
+        if value > 0:
+            approved = self.state.get(TREASURY_PALLET, "approved",
+                                      default=())
+            self.state.put(TREASURY_PALLET, "approved",
+                           approved + ((beneficiary, value),))
         self.state.deposit_event(TREASURY_PALLET, "BountyAwarded",
                                  bounty=bid, beneficiary=beneficiary,
                                  amount=value)
 
+    # -- child bounties (pallet_child_bounties, runtime/src/lib.rs:1522) ------
+    # A council-assigned CURATOR subdivides an active bounty: children
+    # carve value out of the parent, the curator awards them directly
+    # (no council motion per child), and the parent can only be awarded
+    # once no child is active — for what remains of its value.
+    def assign_curator(self, bid: int, curator: str) -> None:
+        """Council-only (via motion): curator gains child-bounty rights."""
+        b = self.bounty(bid)
+        if b is None or b[4] != "active":
+            raise DispatchError("treasury.NoBounty", str(bid))
+        if not isinstance(curator, str) or not curator:
+            raise DispatchError("treasury.InvalidBounty", "curator")
+        self.state.put(TREASURY_PALLET, "curator", bid, curator)
+        self.state.deposit_event(TREASURY_PALLET, "CuratorAssigned",
+                                 bounty=bid, curator=curator)
+
+    def _require_curator(self, who: str, bid: int):
+        b = self.bounty(bid)
+        if b is None or b[4] != "active":
+            raise DispatchError("treasury.NoBounty", str(bid))
+        if self.state.get(TREASURY_PALLET, "curator", bid) != who:
+            raise DispatchError("treasury.NotCurator", str(bid))
+        return b
+
+    def child_bounty(self, bid: int, cid: int):
+        return self.state.get(TREASURY_PALLET, "child", bid, cid)
+
+    def add_child_bounty(self, who: str, bid: int, description: bytes,
+                         value: int) -> int:
+        b = self._require_curator(who, bid)
+        if not isinstance(value, int) or value <= 0 \
+                or not isinstance(description, bytes) \
+                or len(description) > 128:
+            raise DispatchError("treasury.InvalidBounty")
+        carved = self.state.get(TREASURY_PALLET, "children_value", bid,
+                                default=0)
+        if carved + value > b[2]:
+            raise DispatchError("treasury.InsufficientBountyValue")
+        cid = self.state.get(TREASURY_PALLET, "next_child", bid, default=0)
+        self.state.put(TREASURY_PALLET, "next_child", bid, cid + 1)
+        self.state.put(TREASURY_PALLET, "child", bid, cid,
+                       (description, value, "active"))
+        self.state.put(TREASURY_PALLET, "children_value", bid,
+                       carved + value)
+        self.state.deposit_event(TREASURY_PALLET, "ChildBountyAdded",
+                                 bounty=bid, child=cid, value=value)
+        return cid
+
+    def award_child_bounty(self, who: str, bid: int, cid: int,
+                           beneficiary: str) -> None:
+        self._require_curator(who, bid)
+        c = self.child_bounty(bid, cid)
+        if c is None or c[2] != "active":
+            raise DispatchError("treasury.NoBounty", f"{bid}/{cid}")
+        if not isinstance(beneficiary, str) or not beneficiary:
+            raise DispatchError("treasury.InvalidBounty", "beneficiary")
+        self.state.delete(TREASURY_PALLET, "child", bid, cid)
+        # carved value stays carved: the parent award pays the REMAINDER
+        approved = self.state.get(TREASURY_PALLET, "approved", default=())
+        self.state.put(TREASURY_PALLET, "approved",
+                       approved + ((beneficiary, c[1]),))
+        self.state.deposit_event(TREASURY_PALLET, "ChildBountyAwarded",
+                                 bounty=bid, child=cid,
+                                 beneficiary=beneficiary, amount=c[1])
+
+    def close_child_bounty(self, who: str, bid: int, cid: int) -> None:
+        self._require_curator(who, bid)
+        c = self.child_bounty(bid, cid)
+        if c is None:
+            raise DispatchError("treasury.NoBounty", f"{bid}/{cid}")
+        self.state.delete(TREASURY_PALLET, "child", bid, cid)
+        carved = self.state.get(TREASURY_PALLET, "children_value", bid,
+                                default=0)
+        self.state.put(TREASURY_PALLET, "children_value", bid,
+                       max(0, carved - c[1]))    # uncarve: back to parent
+        self.state.deposit_event(TREASURY_PALLET, "ChildBountyClosed",
+                                 bounty=bid, child=cid)
+
+    def _active_children(self, bid: int) -> bool:
+        return any(True for _ in self.state.iter_prefix(
+            TREASURY_PALLET, "child", bid))
+
+    def _clear_bounty_state(self, bid: int) -> None:
+        """Symmetric cleanup on every bounty-ending path: curator and
+        child-accounting keys must not outlive the bounty row."""
+        self.state.delete(TREASURY_PALLET, "bounty", bid)
+        self.state.delete(TREASURY_PALLET, "curator", bid)
+        self.state.delete(TREASURY_PALLET, "children_value", bid)
+        self.state.delete(TREASURY_PALLET, "next_child", bid)
+
     def close_bounty(self, bid: int) -> None:
         """Council drops a bounty; a still-'proposed' bounty's bond is
         slashed to the treasury (spurious proposal), an active one is
-        simply retired."""
+        simply retired. A bounty with ACTIVE child bounties cannot be
+        closed — close or award the children first, or their carved
+        value would be orphaned (pallet_child_bounties' rule)."""
         b = self.bounty(bid)
         if b is None:
             raise DispatchError("treasury.NoBounty", str(bid))
+        if self._active_children(bid):
+            raise DispatchError("treasury.HasActiveChildBounty", str(bid))
         who, _, _, bond, status = b
-        self.state.delete(TREASURY_PALLET, "bounty", bid)
+        self._clear_bounty_state(bid)
         if status == "proposed" and bond:
             self.balances.slash_reserved(who, bond, TREASURY_ACCOUNT)
         self.state.deposit_event(TREASURY_PALLET, "BountyClosed",

@@ -20,7 +20,9 @@ import hashlib
 from .. import constants
 from .audit import Audit
 from .balances import Balances
+from .assets import Assets
 from .cacher import Cacher
+from .indices import Indices, Preimage
 from .contracts import Contracts
 from .election import Election
 from .evm import Evm
@@ -54,6 +56,7 @@ ROOT_ONLY = {
     "council.set_members",
     "technical_committee.set_members",
     "system.apply_runtime_upgrade",
+    "assets.set_fee_rate",
 }
 
 # the dispatch surface — FRAME's #[pallet::call] analog. Pallet
@@ -82,6 +85,14 @@ SIGNED_CALLS = {
     "sminer.faucet",
     "evm.deposit", "evm.withdraw", "evm.deploy", "evm.call",
     "contracts.deploy", "contracts.call",
+    "assets.create", "assets.set_team", "assets.transfer_ownership",
+    "assets.set_metadata", "assets.mint", "assets.burn",
+    "assets.transfer", "assets.freeze", "assets.thaw",
+    "assets.freeze_asset", "assets.thaw_asset", "assets.set_fee_asset",
+    "indices.claim", "indices.free", "indices.transfer",
+    "preimage.note_preimage", "preimage.unnote_preimage",
+    "treasury.add_child_bounty", "treasury.award_child_bounty",
+    "treasury.close_child_bounty",
     "tee_worker.register", "tee_worker.exit",
     "file_bank.create_bucket", "file_bank.delete_bucket",
     "file_bank.upload_declaration", "file_bank.transfer_report",
@@ -171,6 +182,9 @@ class Runtime:
         self.scheduler = Scheduler(s)
         self.oss = Oss(s)
         self.cacher = Cacher(s, self.balances)
+        self.assets = Assets(s, self.balances)
+        self.indices = Indices(s, self.balances)
+        self.preimage = Preimage(s, self.balances)
         self.staking = Staking(s, self.balances,
                                slash_defer_eras=self.config.slash_defer_eras)
         self.credit = SchedulerCredit(
@@ -201,6 +215,9 @@ class Runtime:
             "scheduler": self.scheduler,
             "oss": self.oss,
             "cacher": self.cacher,
+            "assets": self.assets,
+            "indices": self.indices,
+            "preimage": self.preimage,
             "staking": self.staking,
             "scheduler_credit": self.credit,
             "tee_worker": self.tee_worker,
@@ -318,8 +335,8 @@ class Runtime:
         """Pre-dispatch validity (the SignedExtra checks): shape,
         signature over (genesis, nonce, call), account-key binding,
         sequential nonce, fee affordability. Raises DispatchError when
-        invalid; returns the fee so apply_signed charges what was
-        checked without re-encoding."""
+        invalid; returns (fee, asset_funding) so apply_signed charges
+        exactly what was checked without re-resolving anything."""
         if not isinstance(xt, SignedExtrinsic):
             raise DispatchError("system.NotSigned", str(type(xt).__name__))
         self._check_shape(xt)
@@ -335,12 +352,17 @@ class Runtime:
             raise DispatchError(
                 "system.BadNonce", f"{xt.call}: {xt.nonce} != {expected}")
         fee = self.tx_fee(xt)
-        if self.balances.free(xt.signer) < fee:
+        # AssetTxPayment: an account preference + covering asset
+        # balance satisfies affordability; else native tokens must.
+        # The resolved funding is RETURNED so apply_signed charges
+        # exactly what was checked (no re-resolution, no divergence).
+        in_asset = self.assets.fee_in_asset(xt.signer, fee)
+        if in_asset is None and self.balances.free(xt.signer) < fee:
             raise DispatchError("system.CannotPayFee", xt.signer)
         if at_apply and xt.call in ROOT_ONLY \
                 and xt.signer != self.system.sudo():
             raise DispatchError("system.BadOrigin", xt.call)
-        return fee
+        return fee, in_asset
 
     def apply_signed(self, xt: SignedExtrinsic):
         """Authenticated dispatch inside block execution. Signature,
@@ -348,15 +370,22 @@ class Runtime:
         key binding, and fee charge stick even if the call itself
         fails (frame-system semantics: replay protection and fees are
         not rolled back with the dispatch)."""
-        fee = self.validate_signed(xt, at_apply=True)
+        fee, in_asset = self.validate_signed(xt, at_apply=True)
         self.system.bind_account_key(xt.signer, xt.public)
         self.system.bump_nonce(xt.signer)
         if fee:
-            # 80% treasury / 20% block author (runtime/src/lib.rs:190-204)
+            # 80% treasury / 20% block author (runtime/src/lib.rs:190-204);
+            # accounts opted into AssetTxPayment pay in their chosen
+            # asset when it covers the fee (assets.py)
             author = self.state.get("system", "author", default="")
-            self.balances.transfer(xt.signer, TREASURY, fee * 8 // 10)
-            self.balances.transfer(xt.signer, author or TREASURY,
-                                   fee - fee * 8 // 10)
+            if in_asset is not None:
+                aid, asset_fee = in_asset
+                self.assets.charge_fee(xt.signer, aid, asset_fee,
+                                       TREASURY, author)
+            else:
+                self.balances.transfer(xt.signer, TREASURY, fee * 8 // 10)
+                self.balances.transfer(xt.signer, author or TREASURY,
+                                       fee - fee * 8 // 10)
         origin = ROOT if xt.call in ROOT_ONLY else xt.signer
         return self.apply_extrinsic(origin, xt.call, *xt.args,
                                     **dict(xt.kwargs))
@@ -382,6 +411,12 @@ class Runtime:
         self.state.archive_events()
         self.state.block += 1
         self.state.put("system", "author", author)
+        # the Timestamp role (pallet_timestamp, id 2): slots are fixed
+        # 6 s, so the chain clock is DERIVED — block height times the
+        # slot duration — rather than an author-supplied inherent (no
+        # clock-skew surface, same monotonicity guarantee)
+        self.state.put("system", "now_ms",
+                       self.state.block * constants.MILLISECS_PER_BLOCK)
         if randomness is not None:
             self.set_randomness(randomness)
         else:

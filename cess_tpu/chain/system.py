@@ -57,6 +57,11 @@ class System:
         self.state.put(PALLET, "session_key", who, public)
         self.state.deposit_event(PALLET, "SessionKeySet", who=who)
 
+    def now_ms(self) -> int:
+        """Chain clock (the pallet_timestamp role): derived from block
+        height at the fixed 6 s slot duration, written by init_block."""
+        return self.state.get(PALLET, "now_ms", default=0)
+
     # -- sudo ------------------------------------------------------------------
     def sudo(self) -> str | None:
         return self.state.get(PALLET, "sudo")

@@ -112,8 +112,14 @@ class TeeWorker:
         if w.bls_pk:
             # preserve the verdict-signing key: sealed verdicts in the
             # audit log must stay publicly verifiable AFTER the worker
-            # leaves (an exited TEE must not launder its history)
-            self.state.put(PALLET, "retired_bls", controller, w.bls_pk)
+            # leaves (an exited TEE must not launder its history).
+            # APPEND-ONLY: a re-registration with a new key followed by
+            # another exit must not overwrite older eras' keys
+            old = self.state.get(PALLET, "retired_bls", controller,
+                                 default=())
+            if w.bls_pk not in old:
+                self.state.put(PALLET, "retired_bls", controller,
+                               old + (w.bls_pk,))
         self.state.delete(PALLET, "worker", controller)
         self.state.deposit_event(PALLET, "ExitTeeWorker",
                                  controller=controller)
@@ -125,14 +131,27 @@ class TeeWorker:
     def tee_podr2_pk(self) -> bytes | None:
         return self.state.get(PALLET, "podr2_pk")
 
+    def bls_keys_of(self, controller: str) -> tuple[bytes, ...]:
+        """EVERY verdict-signing key this controller has ever held
+        (live + retired eras) — the trusted set a sealed record's
+        stamped key must belong to. A controller that exits and
+        re-registers with a new key keeps its whole history."""
+        keys = self.state.get(PALLET, "retired_bls", controller,
+                              default=())
+        w = self.worker(controller)
+        if w is not None and w.bls_pk and w.bls_pk not in keys:
+            keys = keys + (w.bls_pk,)
+        return keys
+
     def bls_key_of(self, controller: str) -> bytes:
-        """The controller's verdict-signing key, live or retired —
-        what verdict re-verification must use."""
+        """The controller's CURRENT verdict-signing key (live, else
+        the most recently retired)."""
         w = self.worker(controller)
         if w is not None and w.bls_pk:
             return w.bls_pk
-        return self.state.get(PALLET, "retired_bls", controller,
-                              default=b"")
+        keys = self.state.get(PALLET, "retired_bls", controller,
+                              default=())
+        return keys[-1] if keys else b""
 
     # -- ScheduleFind trait (lib.rs:287-321) -------------------------------------
     def controller_list(self) -> tuple[str, ...]:

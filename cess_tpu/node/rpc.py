@@ -281,9 +281,11 @@ class RpcServer:
             # pubkey: everything an external auditor needs to re-run
             # audit.reverify_verdict offline (public verifiability)
             recs = rt.audit.verdicts()
-            # bls_key_of falls back to the retired-key registry, so an
-            # exited TEE's sealed history stays verifiable
-            keys = {t: rt.tee_worker.bls_key_of(t)
+            # the FULL key history per TEE (live + retired eras): a
+            # worker that exited — even one that re-registered with a
+            # new key — leaves its sealed history verifiable, and
+            # records' stamped keys are checked against this set
+            keys = {t: list(rt.tee_worker.bls_keys_of(t))
                     for t in sorted({r.tee for r in recs})}
             return {"verdicts": list(recs), "blsKeys": keys}
         if method == "cess_challenge":

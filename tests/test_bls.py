@@ -186,7 +186,8 @@ def test_sealed_verdict_accepted_and_reverifiable():
     (rec,) = rt.audit.verdicts()
     assert rec == VerdictRecord(tee="tee1", miner="m1",
                                 mission_digest=digest, idle_ok=True,
-                                service_ok=True, bls_sig=sig)
+                                service_ok=True, bls_sig=sig,
+                                bls_pk=pk)
     # ANYONE can recheck the verdict from on-chain data alone
     assert reverify_verdict(rec, rt.tee_worker.worker("tee1").bls_pk)
     # ...and a tampered verdict fails public re-verification
@@ -281,7 +282,12 @@ def test_rpc_verdict_log_is_publicly_reverifiable():
     srv = RpcServer(node, port=0)
     out = srv.handle("cess_teeVerdicts", [])
     (rec,) = out["verdicts"]
-    assert reverify_verdict(rec, out["blsKeys"]["tee1"])
+    # blsKeys carries the FULL era history; the record's stamped key
+    # must be in it, and verification uses the stamp
+    assert rec.bls_pk in out["blsKeys"]["tee1"]
+    assert reverify_verdict(rec, rec.bls_pk)
+    from cess_tpu.chain.audit import reverify_verdicts_batch
+    assert reverify_verdicts_batch(out["verdicts"], out["blsKeys"])
 
 
 def test_batch_reverification_of_verdict_log():
@@ -340,6 +346,50 @@ def test_exited_tee_verdicts_stay_verifiable():
     node = Node(dev_spec(), "xr", {})
     node.runtime = rt
     out = RpcServer(node, port=0).handle("cess_teeVerdicts", [])
-    assert out["blsKeys"]["tee1"] == pk
+    assert out["blsKeys"]["tee1"] == [pk]
     (rec,) = out["verdicts"]
-    assert reverify_verdict(rec, out["blsKeys"]["tee1"])
+    assert reverify_verdict(rec, rec.bls_pk)
+    from cess_tpu.chain.audit import reverify_verdicts_batch
+    assert reverify_verdicts_batch(out["verdicts"], out["blsKeys"])
+
+
+def test_rotated_tee_key_history_stays_verifiable():
+    """Review finding (fixed): exit -> re-register with a NEW key ->
+    exit again must keep BOTH eras' sealed verdicts verifiable (the
+    record stamps its sealing key; the registry keeps every era)."""
+    from cess_tpu.chain.audit import reverify_verdicts_batch
+
+    rt, sk1, pk1 = _setup()
+    m1 = _queue_mission(rt, "tee1", miner="mx")
+    sig = bls.sign(sk1, audit_mod.verdict_message(
+        "tee1", audit_mod.mission_digest(m1), True, True))
+    rt.apply_extrinsic("tee1", "audit.submit_verify_result", "mx", True,
+                       True, sig)
+    rt.apply_extrinsic("tee1", "tee_worker.exit")
+    # re-register the SAME controller with a brand-new key
+    root_kp = generate_rsa_keypair(1024, seed=31)
+    signer_kp = generate_rsa_keypair(1024, seed=32)
+    cert = issue_cert(root_kp, "ias-signer", signer_kp.public)
+    sk2, pk2 = bls.keygen(b"second-era-key")
+    report, rsig = issue_report(signer_kp, b"\x09" * 32, b"podr2pk",
+                                "tee1", bls_pk=pk2)
+    rt.apply_extrinsic("tee1", "tee_worker.register", "stash1", b"peer",
+                       b"podr2pk", report, rsig, (cert,), pk2,
+                       bls.prove_possession(sk2, pk2))
+    m2 = _queue_mission(rt, "tee1", miner="my")
+    sig2 = bls.sign(sk2, audit_mod.verdict_message(
+        "tee1", audit_mod.mission_digest(m2), True, True))
+    rt.apply_extrinsic("tee1", "audit.submit_verify_result", "my", True,
+                       True, sig2)
+    rt.apply_extrinsic("tee1", "tee_worker.exit")
+    # both eras' keys are preserved; both records verify
+    keys = rt.tee_worker.bls_keys_of("tee1")
+    assert pk1 in keys and pk2 in keys
+    recs = rt.audit.verdicts()
+    assert len(recs) == 2
+    assert reverify_verdicts_batch(recs, {"tee1": list(keys)})
+    # a record whose stamp is NOT in the trusted set fails
+    import dataclasses
+    rogue_sk, rogue_pk = bls.keygen(b"rogue")
+    forged = dataclasses.replace(recs[0], bls_pk=rogue_pk)
+    assert not reverify_verdicts_batch([forged], {"tee1": list(keys)})

@@ -1,0 +1,100 @@
+"""Indices, Preimage, Timestamp-role clock, and child bounties
+(reference pallet_indices/pallet_preimage/pallet_timestamp/
+pallet_child_bounties, runtime/src/lib.rs:1486-1522)."""
+import hashlib
+
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.state import DispatchError
+
+D = constants.DOLLARS
+
+
+@pytest.fixture
+def rt():
+    rt = Runtime(RuntimeConfig(era_blocks=1000))
+    for who in ("alice", "bob", "c1", "c2", "c3", "curt"):
+        rt.fund(who, 1_000 * D)
+    rt.apply_extrinsic("root", "council.set_members", ("c1", "c2", "c3"))
+    return rt
+
+
+def test_indices_claim_free_transfer(rt):
+    rt.apply_extrinsic("alice", "indices.claim", 42)
+    assert rt.indices.lookup(42) == "alice"
+    with pytest.raises(DispatchError, match="InUse"):
+        rt.apply_extrinsic("bob", "indices.claim", 42)
+    # deposit reserved; freeing refunds it
+    free_before = rt.balances.free("alice")
+    rt.apply_extrinsic("alice", "indices.transfer", 42, "bob")
+    assert rt.indices.lookup(42) == "bob"
+    assert rt.balances.free("alice") > free_before   # refund came back
+    with pytest.raises(DispatchError, match="NotOwner"):
+        rt.apply_extrinsic("alice", "indices.free", 42)
+    rt.apply_extrinsic("bob", "indices.free", 42)
+    assert rt.indices.lookup(42) is None
+
+
+def test_preimage_note_fetch_unnote(rt):
+    blob = b"a large governance call" * 10
+    h = rt.apply_extrinsic("alice", "preimage.note_preimage", blob)
+    assert h == hashlib.sha256(blob).digest()
+    assert rt.preimage.preimage(h) == blob
+    with pytest.raises(DispatchError, match="AlreadyNoted"):
+        rt.apply_extrinsic("bob", "preimage.note_preimage", blob)
+    with pytest.raises(DispatchError, match="NotNoter"):
+        rt.apply_extrinsic("bob", "preimage.unnote_preimage", h)
+    rt.apply_extrinsic("alice", "preimage.unnote_preimage", h)
+    assert rt.preimage.preimage(h) is None
+    with pytest.raises(DispatchError, match="TooBig"):
+        rt.apply_extrinsic("alice", "preimage.note_preimage",
+                           b"\0" * (128 * 1024 + 1))
+
+
+def test_chain_clock_advances_with_blocks(rt):
+    rt.advance_blocks(3)
+    assert rt.system.now_ms() \
+        == rt.state.block * constants.MILLISECS_PER_BLOCK
+
+
+def _council_pass(rt, call, args):
+    rt.apply_extrinsic("c1", "council.propose", call, args)
+    mid = rt.state.get("council", "next_motion") - 1
+    rt.apply_extrinsic("c2", "council.vote", mid, True)
+    rt.apply_extrinsic("c3", "council.close", mid)
+
+
+def test_child_bounties_full_flow(rt):
+    rt.fund(rt.treasury_pallet.ACCOUNT
+            if hasattr(rt.treasury_pallet, "ACCOUNT") else "treasury",
+            10_000 * D)
+    bid = rt.apply_extrinsic("alice", "treasury.propose_bounty",
+                             b"build the thing", 100 * D)
+    _council_pass(rt, "treasury.approve_bounty", (bid,))
+    _council_pass(rt, "treasury.assign_curator", (bid, "curt"))
+    # only the curator can carve children
+    with pytest.raises(DispatchError, match="NotCurator"):
+        rt.apply_extrinsic("bob", "treasury.add_child_bounty", bid,
+                           b"sub", 10 * D)
+    c0 = rt.apply_extrinsic("curt", "treasury.add_child_bounty", bid,
+                            b"sub-task A", 30 * D)
+    c1 = rt.apply_extrinsic("curt", "treasury.add_child_bounty", bid,
+                            b"sub-task B", 20 * D)
+    # children cannot carve more than the parent holds
+    with pytest.raises(DispatchError, match="InsufficientBountyValue"):
+        rt.apply_extrinsic("curt", "treasury.add_child_bounty", bid,
+                           b"too much", 60 * D)
+    # parent cannot be awarded while children are active (exercised on
+    # the pallet surface the council motion dispatches into)
+    with pytest.raises(DispatchError, match="HasActiveChildBounty"):
+        rt.treasury_pallet.award_bounty(bid, "alice")
+    rt.apply_extrinsic("curt", "treasury.award_child_bounty", bid, c0,
+                       "bob")
+    rt.apply_extrinsic("curt", "treasury.close_child_bounty", bid, c1)
+    # closing c1 uncarves its 20: the parent remainder is 100-30 = 70
+    rt.treasury_pallet.award_bounty(bid, "alice")
+    approved = dict(rt.state.get("treasury", "approved", default=()))
+    assert approved.get("bob") == 30 * D
+    assert approved.get("alice") == 70 * D
