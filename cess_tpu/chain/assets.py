@@ -150,25 +150,38 @@ class Assets:
         self.state.deposit_event(PALLET, "Burned", asset_id=asset_id,
                                  who=target, amount=burned)
 
-    def _debit(self, asset_id: int, a: AssetDetails, who: str,
-               amount: int) -> int:
-        """Take ``amount``; a remainder below min_balance is dust and
-        burns too (pallet_assets' keep-alive rule). Returns the total
-        removed from the account; supply is updated for the part that
-        left circulation."""
+    def _withdraw(self, asset_id: int, a: AssetDetails, who: str,
+                  amount: int) -> int:
+        """THE one implementation of the min_balance debit rule: remove
+        ``amount`` from ``who``; a remainder below min_balance is dust.
+        Returns the dust (which has left the account but NOT yet been
+        burned from supply — the caller decides where amount goes)."""
         have = self.balance(asset_id, who)
         if have < amount:
             raise DispatchError("assets.BalanceLow")
         left = have - amount
+        dust = 0
         if 0 < left < a.min_balance:
-            amount, left = have, 0     # dust the remainder
+            dust, left = left, 0
         if left:
             self.state.put(PALLET, "account", asset_id, who, left)
         else:
             self.state.delete(PALLET, "account", asset_id, who)
-        self.state.put(PALLET, "asset", asset_id, dataclasses.replace(
-            a, supply=a.supply - amount))
-        return amount
+        return dust
+
+    def _burn_supply(self, asset_id: int, amount: int) -> None:
+        if amount:
+            a = self._require(asset_id)
+            self.state.put(PALLET, "asset", asset_id,
+                           dataclasses.replace(a, supply=a.supply - amount))
+
+    def _debit(self, asset_id: int, a: AssetDetails, who: str,
+               amount: int) -> int:
+        """Burn ``amount`` (plus any dust) out of circulation; returns
+        the total removed."""
+        dust = self._withdraw(asset_id, a, who, amount)
+        self._burn_supply(asset_id, amount + dust)
+        return amount + dust
 
     # -- transfers -----------------------------------------------------------
     def transfer(self, who: str, asset_id: int, dest: str,
@@ -178,25 +191,15 @@ class Assets:
         if a.frozen or self.state.get(PALLET, "frozen", asset_id, who,
                                       default=False):
             raise DispatchError("assets.Frozen")
-        have = self.balance(asset_id, who)
-        if have < amount:
-            raise DispatchError("assets.BalanceLow")
-        dest_have = self.balance(asset_id, dest)
-        if dest_have + amount < a.min_balance:
+        if self.balance(asset_id, dest) + amount < a.min_balance:
             raise DispatchError("assets.BelowMinimum")
-        left = have - amount
-        dust = 0
-        if 0 < left < a.min_balance:
-            dust, left = left, 0       # sender remainder is dust: burn
-        if left:
-            self.state.put(PALLET, "account", asset_id, who, left)
-        else:
-            self.state.delete(PALLET, "account", asset_id, who)
+        dust = self._withdraw(asset_id, a, who, amount)
+        # credit AFTER the debit, re-reading the destination: a
+        # self-transfer is then the identity it should be (stale
+        # pre-debit reads let who == dest mint, review-reproduced)
         self.state.put(PALLET, "account", asset_id, dest,
-                       dest_have + amount)
-        if dust:
-            self.state.put(PALLET, "asset", asset_id,
-                           dataclasses.replace(a, supply=a.supply - dust))
+                       self.balance(asset_id, dest) + amount)
+        self._burn_supply(asset_id, dust)
         self.state.deposit_event(PALLET, "Transferred", asset_id=asset_id,
                                  src=who, dst=dest, amount=amount)
 
@@ -277,25 +280,13 @@ class Assets:
         sinks are system accounts, exempt from the min_balance dust
         rule; a payer remainder below min_balance burns as dust."""
         a = self._require(asset_id)
-        have = self.balance(asset_id, who)
-        if have < fee:
-            raise DispatchError("assets.BalanceLow")
-        left = have - fee
-        dust = 0
-        if 0 < left < a.min_balance:
-            dust, left = left, 0
-        if left:
-            self.state.put(PALLET, "account", asset_id, who, left)
-        else:
-            self.state.delete(PALLET, "account", asset_id, who)
+        dust = self._withdraw(asset_id, a, who, fee)
         to_treasury = fee * 8 // 10
         for dest, amt in ((treasury, to_treasury),
                           (author or treasury, fee - to_treasury)):
             if amt:
                 self.state.put(PALLET, "account", asset_id, dest,
                                self.balance(asset_id, dest) + amt)
-        if dust:
-            self.state.put(PALLET, "asset", asset_id,
-                           dataclasses.replace(a, supply=a.supply - dust))
+        self._burn_supply(asset_id, dust)
         self.state.deposit_event(PALLET, "FeePaid", who=who,
                                  asset_id=asset_id, amount=fee)

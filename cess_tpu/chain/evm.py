@@ -33,10 +33,28 @@ GAS_CAP = 5_000_000       # per-call ceiling (block-stall bound)
 DEFAULT_GAS = 1_000_000
 MAX_CODE = 64 * 1024
 
+# base-fee market (the pallet_base_fee / pallet_dynamic_fee role,
+# ref runtime/src/lib.rs:1527-1528): EIP-1559-style — the per-block
+# base fee moves up to 1/8 toward demand, measured against a gas
+# target of half the block's practical capacity. The fee is what Eth
+# tooling reads via eth_gasPrice / eth_feeHistory; execution costs
+# stay weight-fee denominated (the boundary's documented scope).
+INITIAL_BASE_FEE = 10 ** 9          # 1 gwei
+MIN_BASE_FEE = 7
+GAS_TARGET_PER_BLOCK = GAS_CAP // 2
+FEE_HISTORY_MAX = 1024
+
 
 def eth_address(who: str) -> bytes:
     """Deterministic 20-byte EVM address for a native account."""
     return hashlib.sha256(b"evm-addr:" + who.encode()).digest()[:20]
+
+
+def next_base_fee(base: int, gas_used: int,
+                  target: int = GAS_TARGET_PER_BLOCK) -> int:
+    """EIP-1559 update rule: up to +-1/8 per block toward demand."""
+    delta = base * (gas_used - target) // target // 8
+    return max(MIN_BASE_FEE, base + delta)
 
 
 class Evm:
@@ -109,6 +127,7 @@ class Evm:
         if len(runtime) > MAX_CODE:
             raise DispatchError("evm.InvalidCode", "runtime too large")
         self.state.put(PALLET, "code", addr, runtime)
+        self._note_gas(res.gas_used)   # deploys count toward the market
         self._archive_logs(res.logs)
         self.state.deposit_event(PALLET, "Deployed", who=who,
                                  address=addr, code_len=len(runtime),
@@ -200,6 +219,7 @@ class Evm:
         except EvmError as e:
             raise DispatchError("evm.ExecutionFailed", str(e)) from e
         world.commit()
+        self._note_gas(res.gas_used)
         self._archive_logs(res.logs)
         self.state.deposit_event(PALLET, "Called", who=who,
                                  address=address, out_len=len(res.output),
@@ -234,6 +254,48 @@ class Evm:
         except EvmError as e:
             raise DispatchError("evm.ExecutionFailed", str(e)) from e
         return res.output
+
+    # -- base-fee market -----------------------------------------------------
+    def _note_gas(self, gas_used: int) -> None:
+        self.state.put(PALLET, "block_gas",
+                       self.state.get(PALLET, "block_gas", default=0)
+                       + gas_used)
+
+    def base_fee(self) -> int:
+        return self.state.get(PALLET, "base_fee",
+                              default=INITIAL_BASE_FEE)
+
+    def on_initialize(self) -> None:
+        """Roll the fee market: last block's demand moves the base fee
+        (runtime hook, called once per block before dispatches)."""
+        used = self.state.get(PALLET, "block_gas", default=0)
+        base = self.base_fee()
+        self.state.put(PALLET, "fee_hist", self.state.block - 1,
+                       (base, used))
+        stale = self.state.block - 1 - FEE_HISTORY_MAX
+        if stale >= 0:
+            self.state.delete(PALLET, "fee_hist", stale)
+        self.state.put(PALLET, "base_fee", next_base_fee(base, used))
+        self.state.put(PALLET, "block_gas", 0)
+
+    def fee_history(self, count: int, newest: int) -> dict:
+        """eth_feeHistory shape: per-block base fees + gas-used ratios
+        for up to ``count`` blocks ending at ``newest``."""
+        count = max(0, min(count, FEE_HISTORY_MAX))
+        oldest = max(0, newest - count + 1)
+        fees, ratios = [], []
+        for n in range(oldest, newest + 1):
+            base, used = self.state.get(PALLET, "fee_hist", n,
+                                        default=(INITIAL_BASE_FEE, 0))
+            fees.append(base)
+            ratios.append(round(used / GAS_CAP, 6))
+        # trailing entry = block newest+1's base fee (eth_feeHistory
+        # shape): the recorded one for historical windows, the live one
+        # only when the window ends at the head
+        nxt = self.state.get(PALLET, "fee_hist", newest + 1)
+        fees.append(nxt[0] if nxt is not None else self.base_fee())
+        return {"oldestBlock": oldest, "baseFeePerGas": fees,
+                "gasUsedRatio": ratios}
 
     # -- logs (eth_getLogs backing store) ------------------------------------
     def _archive_logs(self, logs) -> None:

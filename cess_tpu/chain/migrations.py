@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from .state import State
 
-SPEC_VERSION = 110   # reference snapshot is 109 (runtime/src/lib.rs:173)
+SPEC_VERSION = 111   # reference snapshot is 109 (runtime/src/lib.rs:173)
 
 SYSTEM = "system"
 
@@ -57,10 +57,23 @@ def _migrate_tee_worker_v2(state: State) -> int:
     return len(pins) - len(kept)
 
 
+def _migrate_tee_worker_v3(state: State) -> int:
+    """retired_bls changed from a single bytes key to an append-only
+    tuple of era keys (exit/re-register must not lose old eras): wrap
+    old-format entries."""
+    n = 0
+    for key, v in list(state.iter_prefix("tee_worker", "retired_bls")):
+        if isinstance(v, bytes):
+            state.put("tee_worker", "retired_bls", *key, (v,))
+            n += 1
+    return n
+
+
 # (pallet, target_version, fn) — fn returns #entries transformed
 MIGRATIONS = [
     ("staking", 2, _migrate_staking_v2),
     ("tee_worker", 2, _migrate_tee_worker_v2),
+    ("tee_worker", 3, _migrate_tee_worker_v3),
 ]
 
 

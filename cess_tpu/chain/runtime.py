@@ -422,6 +422,7 @@ class Runtime:
         else:
             self._update_randomness()
         self.audit.on_initialize()
+        self.evm.on_initialize()      # base-fee market roll
         dead = self.storage_handler.on_initialize()
         self.file_bank.on_initialize(dead)
         self.credit.on_initialize()

@@ -410,6 +410,25 @@ class RpcServer:
             return hex(_eth_chain_id(node.spec))
         if method == "eth_blockNumber":
             return hex(node.head().number)
+        if method == "eth_gasPrice":
+            return hex(rt.evm.base_fee())
+        if method == "eth_feeHistory":
+            try:
+                count = params[0] if params else 4
+                if isinstance(count, str):
+                    count = int(count, 16)
+                newest = rt.state.block - 1
+                if len(params) > 1 and params[1] not in ("latest",
+                                                        "pending", None):
+                    newest = min(newest,
+                                 self._blocknum(params[1], newest))
+                if not isinstance(count, int) or isinstance(count, bool) \
+                        or count < 0:
+                    raise ValueError("count must be a non-negative int")
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS,
+                               f"expected [count, newest?]: {e}") from e
+            return rt.evm.fee_history(count, newest)
         if method == "eth_getBalance":
             if not params or not isinstance(params[0], str):
                 raise RpcError(INVALID_PARAMS, "expected [account]")

@@ -130,3 +130,17 @@ def test_asset_fee_makes_broke_account_viable(rt):
     xt2 = _signed(rt, key, "dave", "system.remark", (b"again",))
     with pytest.raises(DispatchError, match="CannotPayFee"):
         rt.apply_signed(xt2)
+
+
+def test_self_transfer_is_identity(rt):
+    """Review-reproduced inflation bug (fixed): transferring to
+    yourself must not mint — balance and supply are invariant."""
+    rt.apply_extrinsic("alice", "assets.create", 9, 1)
+    rt.apply_extrinsic("alice", "assets.mint", 9, "bob", 100)
+    rt.apply_extrinsic("bob", "assets.transfer", 9, "bob", 100)
+    assert rt.assets.balance(9, "bob") == 100
+    assert rt.assets.asset(9).supply == 100
+    for _ in range(3):
+        rt.apply_extrinsic("bob", "assets.transfer", 9, "bob", 40)
+    assert rt.assets.balance(9, "bob") == 100
+    assert rt.assets.asset(9).supply == 100

@@ -483,3 +483,43 @@ def test_middle_frame_revert_unwinds_grandchild_writes(rt):
         rt.evm.query(token, calldata(2, bob_w)), "big") == 0
     assert int.from_bytes(
         rt.evm.query(token, calldata(2, b)), "big") == 500
+
+
+def test_base_fee_market_tracks_demand(rt):
+    """The pallet_base_fee/dynamic_fee role: the per-block base fee
+    rises under gas demand and decays toward the floor when idle."""
+    from cess_tpu.chain import evm as evm_mod
+
+    addr = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    start = rt.evm.base_fee()
+    # a busy block (several calls) pushes the NEXT base fee up only if
+    # gas used exceeds the target; these small calls stay below it, so
+    # the fee DECAYS — assert the rule, not a direction guess
+    for i in range(3):
+        rt.apply_extrinsic("dev", "evm.call", addr,
+                           calldata(1, eth_address("bob"), 1))
+    used = rt.state.get("evm", "block_gas", default=0)
+    rt.advance_blocks(1)
+    expect = evm_mod.next_base_fee(start, used)
+    assert rt.evm.base_fee() == expect
+    # idle blocks decay toward (and clamp at) the floor
+    for _ in range(5):
+        rt.advance_blocks(1)
+    assert evm_mod.MIN_BASE_FEE <= rt.evm.base_fee() < expect
+    # synthetic high demand raises the fee
+    assert evm_mod.next_base_fee(1000, evm_mod.GAS_CAP) > 1000
+
+
+def test_eth_gasprice_and_feehistory_rpc(rt):
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Network, Node
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "fee", {"alice": spec.session_key("alice")})
+    Network([node]).run_slots(4)
+    srv = RpcServer(node, port=0)
+    assert int(srv.handle("eth_gasPrice", []), 16) >= 7
+    hist = srv.handle("eth_feeHistory", [3])
+    assert len(hist["baseFeePerGas"]) == len(hist["gasUsedRatio"]) + 1
+    assert all(r == 0.0 for r in hist["gasUsedRatio"])   # idle chain

@@ -45,7 +45,7 @@ def test_upgrade_extrinsic_migrates_old_state():
     rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
     ev = rt.state.events_of("system", "MigrationApplied")
     assert {dict(e.data)["migration"] for e in ev} \
-        == {"staking-v2(1)", "tee_worker-v2(1)"}
+        == {"staking-v2(1)", "tee_worker-v2(1)", "tee_worker-v3(0)"}
     assert migrations.spec_version(s) == migrations.SPEC_VERSION
     assert migrations.storage_version(s, "staking") == 2
     assert s.get("staking", "prefs", "v9") == 0
@@ -212,3 +212,25 @@ def test_eth_namespace_rpc():
         assert call("web3_clientVersion").startswith("cess-tpu")
     finally:
         srv.stop()
+
+
+def test_retired_bls_format_migration():
+    """tee_worker v3: bytes-format retired keys wrap into the
+    append-only tuple format in-band."""
+    import dataclasses as dc
+
+    from cess_tpu.chain import migrations
+    from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+
+    rt = Runtime(RuntimeConfig(era_blocks=1000,
+                               genesis_spec_version=109))
+    s = rt.state
+    s.put("tee_worker", "retired_bls", "old-tee", b"\x01" * 96)
+    s.put("system", "storage_version", "tee_worker", 2)
+    rt.system.set_sudo("alice")
+    rt.fund("alice", 10**12)
+    rt.init_block()
+    rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
+    assert migrations.storage_version(s, "tee_worker") == 3
+    assert s.get("tee_worker", "retired_bls", "old-tee") == (b"\x01" * 96,)
+    assert rt.tee_worker.bls_key_of("old-tee") == b"\x01" * 96
