@@ -96,14 +96,16 @@ def _assert_converged(results, min_finalized=2):
 
 
 def test_three_process_gossip_converges():
-    _assert_converged(_run_cluster(duration=6.0))
+    # duration carries slack for CPU-contended full-suite runs: at
+    # SLOT=0.25 even a loaded box fits the needed slots in 9 s
+    _assert_converged(_run_cluster(duration=9.0))
 
 
 def test_lossy_link_still_converges():
     """Node 0 drops every 3rd outbound message (blocks, votes, status
     alike); redundancy + sync requests must still converge the
     cluster."""
-    _assert_converged(_run_cluster(duration=9.0, drop_every=3),
+    _assert_converged(_run_cluster(duration=13.0, drop_every=3),
                       min_finalized=2)
 
 
