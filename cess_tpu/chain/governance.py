@@ -55,6 +55,11 @@ COUNCIL_CALLS = {
     "treasury.close_bounty",
     "treasury.assign_curator",
     "council.set_members",
+    # TC membership curation (pallet_membership role): council motions
+    # manage the second chamber incrementally
+    "technical_committee.add_member",
+    "technical_committee.remove_member",
+    "technical_committee.swap_member",
     "system.retire_sudo",
     "system.apply_runtime_upgrade",
     "staking.cancel_deferred_slash",
@@ -84,7 +89,7 @@ class Collective:
     def set_members(self, members: tuple[str, ...],
                     prime: str | None = None) -> None:
         if not isinstance(members, tuple) \
-                or not all(isinstance(m, str) for m in members) \
+                or not all(isinstance(m, str) and m for m in members) \
                 or len(set(members)) != len(members):
             raise DispatchError(f"{self.PALLET}.BadMembers")
         if prime is not None and prime not in members:
@@ -220,6 +225,36 @@ class Council(Collective):
 class TechnicalCommittee(Collective):
     PALLET = TC_PALLET
     ALLOWED = TC_CALLS
+
+    # -- membership management (pallet_membership::<Instance1>, ref
+    # runtime/src/lib.rs:1520: the council curates TC membership via
+    # motions, incremental ops instead of wholesale root set_members) --
+    def add_member(self, who: str) -> None:
+        members = self.members()
+        if who in members:
+            raise DispatchError(f"{self.PALLET}.AlreadyMember", who)
+        self.set_members(members + (who,), prime=self.prime())
+
+    def remove_member(self, who: str) -> None:
+        members = self.members()
+        if who not in members:
+            raise DispatchError(f"{self.PALLET}.NotMember", who)
+        prime = self.prime()
+        self.set_members(tuple(m for m in members if m != who),
+                         prime=None if prime == who else prime)
+
+    def swap_member(self, out: str, new: str) -> None:
+        members = self.members()
+        if out not in members:
+            raise DispatchError(f"{self.PALLET}.NotMember", out)
+        if out == new:
+            return            # pallet_membership: self-swap is a no-op
+        if new in members:
+            raise DispatchError(f"{self.PALLET}.AlreadyMember", new)
+        prime = self.prime()
+        self.set_members(
+            tuple(new if m == out else m for m in members),
+            prime=new if prime == out else prime)
 
 
 class Treasury:

@@ -262,3 +262,37 @@ def test_faucet_rate_limited(rt, monkeypatch):
     rt.advance_blocks(2 * ERA)
     rt.apply_extrinsic("newbie", "sminer.faucet", "newbie")
     assert rt.balances.free("newbie") >= 2 * FAUCET_AMOUNT
+
+
+def test_council_curates_tc_membership(rt):
+    """pallet_membership role: council motions add/remove/swap TC
+    members incrementally; the prime follows a swap and clears on
+    removal; self-swap is the reference's no-op."""
+    rt.apply_extrinsic("root", "technical_committee.set_members",
+                       ("t1", "t2"), "t1")
+
+    def council_pass(call, args):
+        rt.apply_extrinsic("c1", "council.propose", call, args)
+        mid = rt.state.get("council", "next_motion") - 1
+        rt.apply_extrinsic("c2", "council.vote", mid, True)
+        rt.apply_extrinsic("c3", "council.close", mid)
+
+    council_pass("technical_committee.add_member", ("t3",))
+    assert set(rt.technical_committee.members()) == {"t1", "t2", "t3"}
+    # duplicate add rejected (exercised on the pallet surface council
+    # motions dispatch into)
+    with pytest.raises(DispatchError, match="AlreadyMember"):
+        rt.technical_committee.add_member("t3")
+    # empty-string members are rejected at the shared validation
+    with pytest.raises(DispatchError, match="BadMembers"):
+        rt.technical_committee.swap_member("t1", "")
+    # self-swap is a successful no-op (pallet_membership semantics)
+    before = rt.technical_committee.members()
+    council_pass("technical_committee.swap_member", ("t2", "t2"))
+    assert rt.technical_committee.members() == before
+    council_pass("technical_committee.swap_member", ("t1", "c1"))
+    assert "t1" not in rt.technical_committee.members()
+    assert rt.technical_committee.prime() == "c1"   # prime followed
+    council_pass("technical_committee.remove_member", ("c1",))
+    assert rt.technical_committee.prime() is None   # prime cleared
+    assert set(rt.technical_committee.members()) == {"t2", "t3"}

@@ -145,7 +145,7 @@ def test_peer_discovery_chain_topology():
     q = ctx.Queue()
     genesis_time = time.time()
     procs = [ctx.Process(target=_chain_worker,
-                         args=(i, ports, q, 7.0, genesis_time))
+                         args=(i, ports, q, 10.0, genesis_time))
              for i in range(N)]
     for p in procs:
         p.start()
