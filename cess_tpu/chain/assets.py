@@ -22,10 +22,14 @@ from __future__ import annotations
 import dataclasses
 
 from .. import codec
+from .. import constants
 from .state import DispatchError, State
 
 PALLET = "assets"
 MAX_METADATA = 64
+# pallet_assets reserves AssetDeposit on create so asset-id squatting
+# and state growth aren't free; refunded by destroy
+ASSET_DEPOSIT = 10 * constants.DOLLARS
 
 
 @codec.register
@@ -77,8 +81,9 @@ class Assets:
 
     # -- lifecycle -----------------------------------------------------------
     def create(self, who: str, asset_id: int, min_balance: int = 1) -> None:
-        """Permissionless create: caller becomes the whole team
-        (pallet_assets create)."""
+        """Permissionless create: caller becomes the whole team and
+        reserves ASSET_DEPOSIT, refunded on destroy (pallet_assets
+        create + AssetDeposit)."""
         if not isinstance(asset_id, int) or isinstance(asset_id, bool) \
                 or asset_id < 0:
             raise DispatchError("assets.BadAssetId")
@@ -87,11 +92,38 @@ class Assets:
         if not isinstance(min_balance, int) or isinstance(min_balance, bool) \
                 or min_balance < 1:
             raise DispatchError("assets.BadMinBalance")
+        self.balances.reserve(who, ASSET_DEPOSIT)
+        self.state.put(PALLET, "deposit", asset_id, (who, ASSET_DEPOSIT))
         self.state.put(PALLET, "asset", asset_id, AssetDetails(
             owner=who, issuer=who, admin=who, freezer=who, supply=0,
             min_balance=min_balance))
         self.state.deposit_event(PALLET, "Created", asset_id=asset_id,
                                  owner=who)
+
+    def destroy(self, who: str, asset_id: int) -> None:
+        """Owner removes a fully-burned asset class; the creation
+        deposit returns to whoever reserved it (pallet_assets destroy,
+        collapsed to the supply == 0 case — accounts must be burned
+        first, so no unbounded teardown inside one dispatch)."""
+        a = self._require(asset_id)
+        if who != a.owner:
+            raise DispatchError("assets.NoPermission")
+        if a.supply != 0:
+            raise DispatchError("assets.InUse", "supply not zero")
+        for suffix, _ in list(self.state.iter_prefix(PALLET, "account",
+                                                     asset_id)):
+            self.state.delete(PALLET, "account", asset_id, *suffix)
+        for suffix, _ in list(self.state.iter_prefix(PALLET, "frozen",
+                                                     asset_id)):
+            self.state.delete(PALLET, "frozen", asset_id, *suffix)
+        dep = self.state.get(PALLET, "deposit", asset_id)
+        if dep is not None:
+            self.balances.unreserve(dep[0], dep[1])
+            self.state.delete(PALLET, "deposit", asset_id)
+        self.state.delete(PALLET, "asset", asset_id)
+        self.state.delete(PALLET, "metadata", asset_id)
+        self.state.delete(PALLET, "fee_rate", asset_id)
+        self.state.deposit_event(PALLET, "Destroyed", asset_id=asset_id)
 
     def set_team(self, who: str, asset_id: int, issuer: str, admin: str,
                  freezer: str) -> None:
@@ -193,6 +225,16 @@ class Assets:
             raise DispatchError("assets.Frozen")
         if self.balance(asset_id, dest) + amount < a.min_balance:
             raise DispatchError("assets.BelowMinimum")
+        if who == dest:
+            # identity after validation: a round-trip through _withdraw
+            # would burn a sub-min_balance remainder as dust on an
+            # intent-neutral operation
+            if self.balance(asset_id, who) < amount:
+                raise DispatchError("assets.BalanceLow")
+            self.state.deposit_event(PALLET, "Transferred",
+                                     asset_id=asset_id, src=who, dst=dest,
+                                     amount=amount)
+            return
         dust = self._withdraw(asset_id, a, who, amount)
         # credit AFTER the debit, re-reading the destination: a
         # self-transfer is then the identity it should be (stale

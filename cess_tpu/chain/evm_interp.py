@@ -20,11 +20,18 @@ Deliberate deviations from mainnet EVM, documented once:
   LOG/SHA3/memory expansion), not the full Berlin/London schedule. Out
   of gas always consumes the limit and reverts state — an infinite
   loop can never stall block production (tested).
-- Inter-contract CALL / STATICCALL / DELEGATECALL run through a host
-  callback (evm.py recursion with commit-on-success overlays, depth
-  cap, 63/64 gas forwarding); value-carrying calls and CREATE from
-  within bytecode remain out of scope (the call fails cleanly —
-  push 0 — matching the boundary's documented contract).
+- Inter-contract CALL / STATICCALL / DELEGATECALL and CREATE/CREATE2
+  run through host callbacks (evm.py recursion with
+  commit-on-success overlays, depth cap, 63/64 gas forwarding).
+  Value-carrying CALL moves EVM-domain balance with full revert
+  semantics; BALANCE/SELFBALANCE read through the ``balance`` hook.
+  CREATE2 addresses derive with sha256 (not keccak, per the SHA3
+  deviation above): sha256("evm-create2:" || creator20 || salt32 ||
+  sha256(init))[:20] — deterministic and predictable by contracts
+  using the same formula, which is the property EIP-1014 exists for.
+- Precompiles 0x1-0x4 (ecrecover / sha256 / ripemd160 / identity)
+  are serviced by the call host in evm.py; ecrecover's address
+  derivation is sha3_256-based (crypto/secp256k1.py docstring).
 
 Execution state (storage, logs) is written through the transactional
 KV ``State``, so the runtime's dispatch transactionality applies:
@@ -59,6 +66,9 @@ G_LOG_DATA = 8
 G_MEM_WORD = 3
 G_COPY_WORD = 3
 G_CALL = 700
+G_CREATE = 32_000
+G_BALANCE = 400
+G_EXT = 700
 
 
 class EvmRevert(Exception):
@@ -160,7 +170,9 @@ def _valid_jumpdests(code: bytes) -> set[int]:
 def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             address: bytes = b"", value: int = 0, gas_limit: int = 1_000_000,
             sload=None, sstore=None, static: bool = False,
-            call_host=None) -> ExecResult:
+            call_host=None, create_host=None, balance=None,
+            extcode=None, origin: bytes = b"",
+            env: dict | None = None) -> ExecResult:
     """Run ``code`` to completion.
 
     sload(key_int) -> int and sstore(key_int, value_int) bridge contract
@@ -171,7 +183,17 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
     inter-contract CALL family (kind in "call"/"static"/"delegate");
     it returns (success, returndata, gas_spent, inner_logs) and NEVER
     raises. Absent a host, CALL-family opcodes fail cleanly (push 0).
-    ``static`` makes SSTORE/LOG* exceptional halts (STATICCALL frame).
+    ``static`` makes SSTORE/LOG*/CREATE* exceptional halts (STATICCALL
+    frame).
+
+    ``create_host(init, value, salt_or_None, fwd_gas)`` services
+    CREATE/CREATE2; returns (addr_int_or_0, returndata, gas_spent,
+    inner_logs) and never raises — addr 0 means the creation failed
+    (returndata then carries the init code's revert payload, EVM
+    semantics). ``balance(addr20) -> int`` backs BALANCE/SELFBALANCE
+    (0 without a host). ``extcode(addr20) -> bytes`` backs
+    EXTCODESIZE/EXTCODECOPY/EXTCODEHASH. ``env`` supplies block
+    context: number, timestamp, chainid, basefee, gasprice, coinbase.
 
     Raises EvmRevert (REVERT opcode, gas charged so far) or EvmError
     (exceptional halt, all gas consumed).
@@ -179,6 +201,10 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
     local: dict[int, int] = {}
     sload = sload or (lambda k: local.get(k, 0))
     sstore = sstore or local.__setitem__
+    balance = balance or (lambda a: 0)
+    extcode = extcode or (lambda a: b"")
+    env = env or {}
+    origin = origin or caller
 
     gas = _Gas(gas_limit)
     mem = _Memory()
@@ -303,6 +329,11 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
         # -- environment --------------------------------------------------
         elif op == 0x30:                            # ADDRESS
             gas.use(G_BASE); push(int.from_bytes(address, "big"))
+        elif op == 0x31:                            # BALANCE
+            gas.use(G_BALANCE)
+            push(balance(pop().to_bytes(32, "big")[-20:]))
+        elif op == 0x32:                            # ORIGIN
+            gas.use(G_BASE); push(int.from_bytes(origin, "big"))
         elif op == 0x33:                            # CALLER
             gas.use(G_BASE); push(int.from_bytes(caller, "big"))
         elif op == 0x34:                            # CALLVALUE
@@ -333,6 +364,24 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
                 mem._expand(doff + size, gas)
                 chunk = code[soff:soff + size] if soff < len(code) else b""
                 mem.write(doff, chunk.ljust(size, b"\0"), gas)
+        elif op == 0x3A:                            # GASPRICE
+            gas.use(G_BASE); push(env.get("gasprice", 0))
+        elif op == 0x3B:                            # EXTCODESIZE
+            gas.use(G_EXT)
+            push(len(extcode(pop().to_bytes(32, "big")[-20:])))
+        elif op == 0x3C:                            # EXTCODECOPY
+            a20 = pop().to_bytes(32, "big")[-20:]
+            doff, soff, size = pop(), pop(), pop()
+            gas.use(G_EXT + G_COPY_WORD * ((size + 31) // 32))
+            if size:
+                mem._expand(doff + size, gas)
+                xc = extcode(a20)
+                chunk = xc[soff:soff + size] if soff < len(xc) else b""
+                mem.write(doff, chunk.ljust(size, b"\0"), gas)
+        elif op == 0x3F:                            # EXTCODEHASH
+            gas.use(G_EXT)
+            xc = extcode(pop().to_bytes(32, "big")[-20:])
+            push(int.from_bytes(sha3(xc), "big") if xc else 0)
         elif op == 0x3D:                            # RETURNDATASIZE
             gas.use(G_BASE); push(len(returndata))
         elif op == 0x3E:                            # RETURNDATACOPY
@@ -342,6 +391,20 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
                 raise EvmError("returndatacopy out of bounds")
             if size:
                 mem.write(doff, returndata[soff:soff + size], gas)
+        # -- block context -------------------------------------------------
+        elif op == 0x41:                            # COINBASE
+            gas.use(G_BASE)
+            push(int.from_bytes(env.get("coinbase", b""), "big"))
+        elif op == 0x42:                            # TIMESTAMP
+            gas.use(G_BASE); push(env.get("timestamp", 0))
+        elif op == 0x43:                            # NUMBER
+            gas.use(G_BASE); push(env.get("number", 0))
+        elif op == 0x46:                            # CHAINID
+            gas.use(G_BASE); push(env.get("chainid", 0))
+        elif op == 0x47:                            # SELFBALANCE
+            gas.use(G_LOW); push(balance(address))
+        elif op == 0x48:                            # BASEFEE
+            gas.use(G_BASE); push(env.get("basefee", 0))
         # -- stack / memory / storage ------------------------------------
         elif op == 0x50:                            # POP
             gas.use(G_BASE); pop()
@@ -392,6 +455,25 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             gas.use(G_LOG + G_LOG_TOPIC * ntopics + G_LOG_DATA * size)
             logs.append(Log(address=address, topics=topics,
                             data=mem.read(off, size, gas)))
+        # -- CREATE / CREATE2 (serviced by create_host) -------------------
+        elif op in (0xF0, 0xF5):                    # CREATE/CREATE2
+            if static:
+                raise EvmError("CREATE in static context")
+            gas.use(G_CREATE)
+            val, off, size = pop(), pop(), pop()
+            salt = pop().to_bytes(32, "big") if op == 0xF5 else None
+            init = mem.read(off, size, gas)
+            fwd = gas.remaining - gas.remaining // 64   # EIP-150
+            if create_host is None:
+                addr_int, retdata, spent, inner_logs = 0, b"", 0, []
+            else:
+                addr_int, retdata, spent, inner_logs = create_host(
+                    init, val, salt, fwd)
+            gas.use(min(spent, fwd))
+            returndata = retdata            # revert payload on failure
+            if addr_int:
+                logs.extend(inner_logs)
+            push(addr_int)
         # -- inter-contract calls (serviced by call_host) -----------------
         elif op in (0xF1, 0xF4, 0xFA):              # CALL/DELEGATECALL/
             gas.use(G_CALL)                         # STATICCALL
@@ -399,8 +481,10 @@ def execute(code: bytes, *, calldata: bytes = b"", caller: bytes = b"",
             val = pop() if op == 0xF1 else 0
             in_off, in_size = pop(), pop()
             out_off, out_size = pop(), pop()
-            if static and val:
+            if static and op == 0xF1 and val:
                 raise EvmError("value transfer in static context")
+            if op == 0xF4:
+                val = value     # apparent value rides along, no transfer
             data = mem.read(in_off, in_size, gas)
             if out_size:
                 mem._expand(out_off + out_size, gas)
@@ -454,6 +538,11 @@ OPS = {
     "CALLVALUE": 0x34, "CALLDATALOAD": 0x35, "CALLDATASIZE": 0x36,
     "CALLDATACOPY": 0x37, "CODESIZE": 0x38, "CODECOPY": 0x39,
     "RETURNDATASIZE": 0x3D, "RETURNDATACOPY": 0x3E,
+    "BALANCE": 0x31, "ORIGIN": 0x32, "GASPRICE": 0x3A,
+    "EXTCODESIZE": 0x3B, "EXTCODECOPY": 0x3C, "EXTCODEHASH": 0x3F,
+    "COINBASE": 0x41, "TIMESTAMP": 0x42, "NUMBER": 0x43,
+    "CHAINID": 0x46, "SELFBALANCE": 0x47, "BASEFEE": 0x48,
+    "CREATE": 0xF0, "CREATE2": 0xF5,
     "CALL": 0xF1, "DELEGATECALL": 0xF4, "STATICCALL": 0xFA,
     "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52,
     "MSTORE8": 0x53, "SLOAD": 0x54, "SSTORE": 0x55, "JUMP": 0x56,

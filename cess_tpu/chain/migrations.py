@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from .state import State
 
-SPEC_VERSION = 111   # reference snapshot is 109 (runtime/src/lib.rs:173)
+SPEC_VERSION = 112   # reference snapshot is 109 (runtime/src/lib.rs:173)
 
 SYSTEM = "system"
 
@@ -69,11 +69,44 @@ def _migrate_tee_worker_v3(state: State) -> int:
     return n
 
 
+def _migrate_evm_v2(state: State) -> int:
+    """EVM ledger re-key (round-5): balances/nonces moved from
+    native-account-string keys to 20-byte EVM addresses, and the
+    backing model changed from per-depositor reserves to the EVM_POT
+    pot account (value-carrying calls need any address's balance to be
+    pot-covered). Old entries are re-keyed and their reserve backing
+    is released into the pot, so pre-upgrade deposits stay withdrawable."""
+    from .evm import EVM_POT, eth_address
+
+    n = 0
+    for (who,), bal in list(state.iter_prefix("evm", "balance")):
+        if not isinstance(who, str):
+            continue
+        state.delete("evm", "balance", who)
+        addr = eth_address(who)
+        state.put("evm", "balance", addr,
+                  state.get("evm", "balance", addr, default=0) + bal)
+        reserved = state.get("balances", "reserved", who, default=0)
+        moved = min(reserved, bal)
+        state.put("balances", "reserved", who, reserved - moved)
+        state.put("balances", "free", EVM_POT,
+                  state.get("balances", "free", EVM_POT, default=0)
+                  + moved)
+        n += 1
+    for (who,), nonce in list(state.iter_prefix("evm", "nonce")):
+        if isinstance(who, str):
+            state.delete("evm", "nonce", who)
+            state.put("evm", "nonce", eth_address(who), nonce)
+            n += 1
+    return n
+
+
 # (pallet, target_version, fn) — fn returns #entries transformed
 MIGRATIONS = [
     ("staking", 2, _migrate_staking_v2),
     ("tee_worker", 2, _migrate_tee_worker_v2),
     ("tee_worker", 3, _migrate_tee_worker_v3),
+    ("evm", 2, _migrate_evm_v2),
 ]
 
 

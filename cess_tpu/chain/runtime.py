@@ -85,7 +85,8 @@ SIGNED_CALLS = {
     "sminer.faucet",
     "evm.deposit", "evm.withdraw", "evm.deploy", "evm.call",
     "contracts.deploy", "contracts.call",
-    "assets.create", "assets.set_team", "assets.transfer_ownership",
+    "assets.create", "assets.destroy", "assets.set_team",
+    "assets.transfer_ownership",
     "assets.set_metadata", "assets.mint", "assets.burn",
     "assets.transfer", "assets.freeze", "assets.thaw",
     "assets.freeze_asset", "assets.thaw_asset", "assets.set_fee_asset",
@@ -133,8 +134,9 @@ FEELESS = {
 # frame-benchmarking-generated per-pallet weights.rs via
 # .maintain/frame-weight-template.hbs, SURVEY.md §6 "Extrinsic
 # weights"). Unit: one balances.transfer dispatch; scaled x10 here so
-# weight fees stay significant next to byte fees. Unlisted calls
-# weigh 0 and pay only base + length fees. Regenerate the table with
+# weight fees stay significant next to byte fees. The table covers
+# EVERY entry of DISPATCHABLE — tests/test_weights.py fails the build
+# if a new call ships unmeasured. Regenerate with
 # `python tools/gen_weights.py --write`.
 from .weights_generated import GENERATED_WEIGHTS
 
@@ -277,8 +279,15 @@ class Runtime:
         self.state.begin_tx()
         try:
             result = fn(*call_args, **kwargs)
-        except DispatchError:
+        except DispatchError as e:
             self.state.rollback_tx()
+            # reverted/trapping EVM executions still did metered work
+            # (and paid for it): count it toward the base-fee market so
+            # sustained reverting load moves the base fee like any
+            # other demand (evm.Evm._fail)
+            gas = getattr(e, "evm_gas_used", 0)
+            if gas:
+                self.evm._note_gas(gas)
             raise
         except Exception as e:
             # A validly-signed extrinsic can still carry arbitrary arg
@@ -315,8 +324,15 @@ class Runtime:
         extrinsic: codec.decode constructs dataclasses without field
         checks, so every field is untrusted until proven well-formed.
         A self-signed-but-malformed tx must fail with a DispatchError
-        (deterministic skip), never a TypeError mid-block."""
+        (deterministic skip), never a TypeError mid-block.
+
+        ``:`` is reserved for internal principals (the contracts VM
+        names cross-contract callers ``contract:<addr>``,
+        contracts.py:396): a signable account named like one could
+        impersonate that contract to any callee doing caller-based
+        auth, so colon names never enter the signed pipeline."""
         ok = (isinstance(xt.signer, str) and xt.signer
+              and ":" not in xt.signer
               and isinstance(xt.public, bytes) and len(xt.public) == 32
               and isinstance(xt.nonce, int) and xt.nonce >= 0
               and isinstance(xt.call, str)
@@ -389,6 +405,50 @@ class Runtime:
         origin = ROOT if xt.call in ROOT_ONLY else xt.signer
         return self.apply_extrinsic(origin, xt.call, *xt.args,
                                     **dict(xt.kwargs))
+
+    def apply_in_block(self, xt) -> None:
+        """Block-execution wrapper around :meth:`apply_signed`: never
+        raises (a failed dispatch becomes a deterministic
+        ExtrinsicFailed event, identical on every replica), and records
+        the transaction-lifecycle artifacts the Ethereum RPC serves —
+        tx-hash -> (block, index) plus a receipt with status, gas used,
+        contract address, and the block-local log range (the
+        pallet-ethereum / fc-rpc receipt mapping,
+        /root/reference/node/src/rpc.rs:229-328). Receipts live in
+        consensus state, so they reorg/rewind with their block."""
+        from .. import codec
+
+        block = self.state.block
+        idx = self.state.get("ethereum", "count", block, default=0)
+        log_start = self.evm.log_seq(block)
+        call = getattr(xt, "call", "<malformed>")
+        try:
+            txhash = hashlib.sha256(codec.encode(xt)).digest()
+        except Exception:
+            txhash = None              # unencodable: skip the eth view
+        try:
+            self.apply_signed(xt)
+        except DispatchError as e:
+            self.state.deposit_event("system", "ExtrinsicFailed",
+                                     call=call, error=e.name)
+            status, error = 0, e.name
+            gas_used = getattr(e, "evm_gas_used", 0) \
+                or CALL_WEIGHTS.get(call, 0)
+            contract = None
+        else:
+            status, error = 1, ""
+            gas_used, contract = CALL_WEIGHTS.get(call, 0), None
+            if call in ("evm.call", "evm.deploy"):
+                gas_used, contract = self.state.get(
+                    "evm", "last_exec", default=(0, None))
+        if txhash is None:
+            return
+        log_count = self.evm.log_seq(block) - log_start
+        self.state.put("ethereum", "txloc", txhash, (block, idx))
+        self.state.put("ethereum", "receipt", block, idx,
+                       (txhash, getattr(xt, "signer", ""), call, status,
+                        error, gas_used, contract, log_start, log_count))
+        self.state.put("ethereum", "count", block, idx + 1)
 
     # -- block execution ---------------------------------------------------------
     def _update_randomness(self) -> None:

@@ -18,6 +18,14 @@ from ..crypto import ed25519
 D = constants.DOLLARS
 
 
+def eth_chain_id(chain_id: str) -> int:
+    """One derivation for eth_chainId, net_version, AND the CHAINID
+    opcode (stamped into genesis state below) — Eth tooling
+    cross-checks all three."""
+    return int.from_bytes(
+        hashlib.sha256(chain_id.encode()).digest()[:4], "big")
+
+
 @dataclasses.dataclass(frozen=True)
 class ValidatorGenesis:
     account: str
@@ -90,6 +98,7 @@ class ChainSpec:
             audit_verify_life=self.audit_verify_life,
             genesis_spec_version=self.genesis_spec_version))
         rt.set_genesis_hash(self.genesis_hash())
+        rt.state.put("system", "chain_id", eth_chain_id(self.chain_id))
         if self.sudo:
             rt.system.set_sudo(self.sudo)
         for who, amount in self.endowed:

@@ -341,14 +341,10 @@ class Node:
         self.runtime.init_block(self.rrsc.block_randomness(claim),
                                 author=claim.authority)
         for xt in extrinsics:
-            try:
-                self.runtime.apply_signed(xt)
-            except DispatchError as e:
-                # deterministic across replicas: every node skips the
-                # same invalid tx with the same event
-                call = getattr(xt, "call", "<malformed>")
-                self.runtime.state.deposit_event(
-                    "system", "ExtrinsicFailed", call=call, error=e.name)
+            # deterministic across replicas: every node skips the same
+            # invalid tx with the same event, and records the same
+            # eth-visible receipt (runtime.apply_in_block)
+            self.runtime.apply_in_block(xt)
 
     def _adopt_block(self, block: Block, undo: list, block0: int,
                      events0: list, persist: bool,

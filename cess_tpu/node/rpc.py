@@ -72,12 +72,9 @@ def _encode(obj):
 
 
 def _eth_chain_id(spec) -> int:
-    """One derivation for eth_chainId AND net_version (Eth tooling
-    cross-checks them)."""
-    import hashlib
+    from .chain_spec import eth_chain_id
 
-    return int.from_bytes(
-        hashlib.sha256(spec.chain_id.encode()).digest()[:4], "big")
+    return eth_chain_id(spec.chain_id)
 
 
 def _decode(obj):
@@ -432,7 +429,8 @@ class RpcServer:
         if method == "eth_getBalance":
             if not params or not isinstance(params[0], str):
                 raise RpcError(INVALID_PARAMS, "expected [account]")
-            return hex(rt.evm.balance(params[0]))
+            # serves both 0x EVM addresses and native account names
+            return hex(rt.evm.balance(_decode(params[0])))
         if method == "eth_getCode":
             if not params:
                 raise RpcError(INVALID_PARAMS, "expected [address]")
@@ -496,6 +494,45 @@ class RpcServer:
             slot = params[1]
             slot = int(slot, 16) if isinstance(slot, str) else int(slot)
             return hex(rt.evm.storage_at(_decode(params[0]), slot))
+        # -- tx lifecycle (fc-rpc Eth: receipts / tx objects / blocks,
+        #    ref node/src/rpc.rs:229-328) --------------------------------
+        if method == "eth_getTransactionReceipt":
+            loc = self._txloc(rt, params)
+            if loc is None:
+                return None
+            return self._receipt_obj(node, rt, *loc)
+        if method == "eth_getTransactionByHash":
+            loc = self._txloc(rt, params)
+            if loc is None:
+                return None
+            block, idx = loc
+            body = node.block_bodies.get(block)
+            if body is None or idx >= len(body.extrinsics):
+                return None
+            return self._tx_obj(node, rt, body.extrinsics[idx], block,
+                                idx)
+        if method == "eth_getBlockByNumber":
+            if not params:
+                raise RpcError(INVALID_PARAMS, "expected [number, full?]")
+            try:
+                n = self._blocknum(params[0], node.head().number)
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
+            full = bool(params[1]) if len(params) > 1 else False
+            return self._eth_block(node, rt, n, full)
+        if method == "eth_getBlockByHash":
+            if not params or not isinstance(params[0], str):
+                raise RpcError(INVALID_PARAMS, "expected [hash, full?]")
+            h = _decode(params[0])
+            header = node.headers.get(h)
+            if header is None or not node._is_canonical(h):
+                return None
+            full = bool(params[1]) if len(params) > 1 else False
+            return self._eth_block(node, rt, header.number, full)
+        if method == "eth_estimateGas":
+            if not params or not isinstance(params[0], dict):
+                raise RpcError(INVALID_PARAMS, "expected [call object]")
+            return self._estimate_gas(rt, params[0])
         raise RpcError(METHOD_NOT_FOUND, f"unknown method {method!r}")
 
     @staticmethod
@@ -523,6 +560,180 @@ class RpcServer:
         if self.service is None:
             return 0
         return sum(1 for c in self.service.conns if c.alive)
+
+    # -- Eth tx lifecycle (receipts / tx objects / blocks) -----------------
+    def _txloc(self, rt, params):
+        if not params or not isinstance(params[0], str):
+            raise RpcError(INVALID_PARAMS, "expected [tx hash]")
+        h = _decode(params[0])
+        if not isinstance(h, bytes) or len(h) != 32:
+            raise RpcError(INVALID_PARAMS, "tx hash must be 32 bytes")
+        return rt.state.get("ethereum", "txloc", h)
+
+    @staticmethod
+    def _canonical_hash(node, n: int) -> bytes:
+        return node.chain[n].hash() if 0 <= n < len(node.chain) \
+            else b"\0" * 32
+
+    @staticmethod
+    def _block_base_fee(rt, block: int) -> int:
+        """The base fee IN FORCE at ``block``: recorded by the NEXT
+        block's fee-market roll, live for the head."""
+        rec = rt.state.get("evm", "fee_hist", block)
+        return rec[0] if rec is not None else rt.evm.base_fee()
+
+    def _tx_obj(self, node, rt, xt, block: int, idx: int) -> dict:
+        import hashlib as _hl
+
+        from .. import codec as _codec
+        from ..chain.evm import GAS_CAP, eth_address
+
+        txhash = _hl.sha256(_codec.encode(xt)).digest()
+        call = getattr(xt, "call", "")
+        args = getattr(xt, "args", ())
+        kw = dict(getattr(xt, "kwargs", ()) or ())
+        to, value, gas, data = None, 0, GAS_CAP, b""
+        if call == "evm.call":
+            to = args[0] if args else None
+            data = args[1] if len(args) > 1 else b""
+            gas = args[2] if len(args) > 2 else kw.get("gas_limit",
+                                                      GAS_CAP)
+            value = args[3] if len(args) > 3 else kw.get("value", 0)
+        elif call == "evm.deploy":
+            data = args[0] if args else b""
+            gas = args[1] if len(args) > 1 else kw.get("gas_limit",
+                                                      GAS_CAP)
+            value = args[2] if len(args) > 2 else kw.get("value", 0)
+        return {
+            "hash": "0x" + txhash.hex(),
+            "nonce": hex(getattr(xt, "nonce", 0)),
+            "blockNumber": hex(block), "transactionIndex": hex(idx),
+            "blockHash": "0x" + self._canonical_hash(node, block).hex(),
+            "from": "0x" + eth_address(getattr(xt, "signer", "")).hex(),
+            "to": "0x" + to.hex() if isinstance(to, bytes) else None,
+            "value": hex(value if isinstance(value, int) else 0),
+            "gas": hex(gas if isinstance(gas, int) else GAS_CAP),
+            "gasPrice": hex(self._block_base_fee(rt, block)),
+            "input": "0x" + (data.hex() if isinstance(data, bytes)
+                             else ""),
+            "call": call,                   # framework extension
+        }
+
+    def _receipt_obj(self, node, rt, block: int, idx: int):
+        from ..chain.evm import eth_address
+
+        rc = rt.state.get("ethereum", "receipt", block, idx)
+        if rc is None:
+            return None
+        (txhash, signer, call, status, error, gas_used, contract,
+         log_start, log_count) = rc
+        bh = "0x" + self._canonical_hash(node, block).hex()
+        cumulative = 0
+        for i in range(idx + 1):
+            r2 = rt.state.get("ethereum", "receipt", block, i)
+            if r2 is not None:
+                cumulative += r2[5]
+        logs = []
+        for seq in range(log_start, log_start + log_count):
+            lg = rt.evm.log_at(block, seq)
+            if lg is None:
+                continue
+            addr, topics, data = lg
+            logs.append({
+                "address": "0x" + addr.hex(),
+                "topics": ["0x" + t.hex() for t in topics],
+                "data": "0x" + data.hex(),
+                "blockNumber": hex(block), "logIndex": hex(seq),
+                "transactionIndex": hex(idx),
+                "transactionHash": "0x" + txhash.hex(),
+                "blockHash": bh, "removed": False})
+        to = None
+        body = node.block_bodies.get(block)
+        if body is not None and idx < len(body.extrinsics):
+            bxt = body.extrinsics[idx]
+            if getattr(bxt, "call", "") == "evm.call" \
+                    and getattr(bxt, "args", ()):
+                to = bxt.args[0]
+        return {
+            "transactionHash": "0x" + txhash.hex(),
+            "transactionIndex": hex(idx),
+            "blockNumber": hex(block), "blockHash": bh,
+            "from": "0x" + eth_address(signer).hex(),
+            "to": "0x" + to.hex() if isinstance(to, bytes) else None,
+            "status": hex(status), "error": error or None,
+            "gasUsed": hex(gas_used),
+            "cumulativeGasUsed": hex(cumulative),
+            "contractAddress": "0x" + contract.hex() if contract
+            else None,
+            "logs": logs, "logsBloom": "0x" + "00" * 256,
+            "effectiveGasPrice": hex(self._block_base_fee(rt, block)),
+            "type": "0x2", "call": call}
+
+    def _eth_block(self, node, rt, n, full: bool):
+        from .. import constants
+        from ..chain.evm import GAS_CAP, eth_address
+
+        if not isinstance(n, int) or n < 0 or n >= len(node.chain):
+            return None
+        header = node.chain[n]
+        count = rt.state.get("ethereum", "count", n, default=0)
+        receipts = [rt.state.get("ethereum", "receipt", n, i)
+                    for i in range(count)]
+        body = node.block_bodies.get(n)
+        txs = []
+        for i, rc in enumerate(receipts):
+            if rc is None:
+                continue
+            if full and body is not None and i < len(body.extrinsics):
+                txs.append(self._tx_obj(node, rt, body.extrinsics[i],
+                                        n, i))
+            else:
+                txs.append("0x" + rc[0].hex())
+        return {
+            "number": hex(n), "hash": "0x" + header.hash().hex(),
+            "parentHash": "0x" + header.parent.hex(),
+            "stateRoot": "0x" + header.state_root.hex(),
+            "miner": "0x" + eth_address(header.author).hex(),
+            "author": header.author,       # framework extension
+            # identical to the TIMESTAMP opcode env: the chain clock is
+            # DERIVED (block * slot duration, runtime.init_block), so
+            # this formula IS system.now_ms for block n
+            "timestamp": hex(n * constants.MILLISECS_PER_BLOCK // 1000),
+            "baseFeePerGas": hex(self._block_base_fee(rt, n)),
+            "gasUsed": hex(sum(rc[5] for rc in receipts
+                               if rc is not None)),
+            "gasLimit": hex(GAS_CAP), "transactions": txs,
+            "logsBloom": "0x" + "00" * 256, "extraData": "0x"}
+
+    def _estimate_gas(self, rt, call_obj: dict) -> str:
+        from ..chain.state import DispatchError
+
+        try:
+            to = call_obj.get("to")
+            to_b = _decode(to) if to else None
+            data_b = _decode(call_obj.get("data")
+                             or call_obj.get("input") or "0x")
+            value = call_obj.get("value", 0)
+            if isinstance(value, str):
+                value = int(value, 16)
+            caller = call_obj.get("from", "")
+            # simulation needs a NATIVE account identity for funding;
+            # a bare 0x address has no reverse mapping, so it
+            # estimates as the anonymous caller
+            if not isinstance(caller, str) or caller.startswith("0x"):
+                caller = ""
+            if to_b is not None and (not isinstance(to_b, bytes)
+                                     or len(to_b) != 20):
+                raise ValueError("to must be a 20-byte address")
+            if not isinstance(data_b, bytes):
+                raise ValueError("data must be 0x hex")
+        except (ValueError, TypeError) as e:
+            raise RpcError(INVALID_PARAMS, str(e)) from e
+        try:
+            return hex(rt.evm.estimate(to_b, data_b, caller=caller,
+                                       value=value))
+        except DispatchError as e:
+            raise RpcError(SERVER_ERROR, str(e)) from e
 
     # -- Eth filters (the EthFilter namespace, node/src/rpc.rs:229-328) ----
     @staticmethod

@@ -144,3 +144,44 @@ def test_self_transfer_is_identity(rt):
         rt.apply_extrinsic("bob", "assets.transfer", 9, "bob", 40)
     assert rt.assets.balance(9, "bob") == 100
     assert rt.assets.asset(9).supply == 100
+
+
+def test_create_reserves_deposit_destroy_refunds(rt):
+    """ADVICE r4: permissionless create reserves ASSET_DEPOSIT so id
+    squatting isn't free; destroy (supply == 0 only) refunds it."""
+    from cess_tpu.chain.assets import ASSET_DEPOSIT
+
+    free0 = rt.balances.free("alice")
+    rt.apply_extrinsic("alice", "assets.create", 11, 1)
+    assert rt.balances.reserved("alice") == ASSET_DEPOSIT
+    assert rt.balances.free("alice") == free0 - ASSET_DEPOSIT
+    # a broke account cannot squat ids
+    with pytest.raises(DispatchError, match="InsufficientBalance"):
+        rt.apply_extrinsic("eve", "assets.create", 12)
+    # destroy is owner-only and requires all units burned first
+    rt.apply_extrinsic("alice", "assets.mint", 11, "bob", 100)
+    with pytest.raises(DispatchError, match="InUse"):
+        rt.apply_extrinsic("alice", "assets.destroy", 11)
+    rt.apply_extrinsic("alice", "assets.burn", 11, "bob", 100)
+    with pytest.raises(DispatchError, match="NoPermission"):
+        rt.apply_extrinsic("bob", "assets.destroy", 11)
+    rt.apply_extrinsic("alice", "assets.destroy", 11)
+    assert rt.assets.asset(11) is None
+    assert rt.balances.reserved("alice") == 0
+    assert rt.balances.free("alice") == free0
+    # the id is reusable after destroy
+    rt.apply_extrinsic("bob", "assets.create", 11)
+
+
+def test_self_transfer_never_burns_dust(rt):
+    """ADVICE r4: balance 10, min_balance 5, self-transfer 7 — the
+    debit path would burn the 3-unit remainder as dust; a self-transfer
+    is the identity after validation."""
+    rt.apply_extrinsic("alice", "assets.create", 10, 5)
+    rt.apply_extrinsic("alice", "assets.mint", 10, "bob", 10)
+    rt.apply_extrinsic("bob", "assets.transfer", 10, "bob", 7)
+    assert rt.assets.balance(10, "bob") == 10
+    assert rt.assets.asset(10).supply == 10
+    # overdrawn self-transfer still fails
+    with pytest.raises(DispatchError, match="BalanceLow"):
+        rt.apply_extrinsic("bob", "assets.transfer", 10, "bob", 11)

@@ -276,3 +276,20 @@ def test_middle_frame_revert_unwinds_grandchild_writes(rt):
     assert not list(rt.state.iter_prefix("contracts", "storage", vault))
     # ...and so did C's event
     assert not any(e.name == "ContractEvent" for e in rt.state.events)
+
+
+def test_reserved_caller_names_cannot_be_signed(rt):
+    """ADVICE r4 (high): the xcall caller identity is
+    'contract:<addr>' (contracts.py); a signable account with that
+    name could impersonate the contract to any callee doing
+    caller-based auth. Colon names never enter the signed pipeline."""
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.crypto import ed25519
+
+    vault = rt.apply_extrinsic("dev", "contracts.deploy", VAULT)
+    key = ed25519.SigningKey.generate(b"mallory")
+    imposter = "contract:" + vault.hex()
+    xt = sign_extrinsic(key, rt.genesis_hash(), imposter, 0,
+                        "system.remark", (b"x",), None)
+    with pytest.raises(DispatchError, match="MalformedTransaction"):
+        rt.validate_signed(xt)

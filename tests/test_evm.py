@@ -523,3 +523,324 @@ def test_eth_gasprice_and_feehistory_rpc(rt):
     hist = srv.handle("eth_feeHistory", [3])
     assert len(hist["baseFeePerGas"]) == len(hist["gasUsedRatio"]) + 1
     assert all(r == 0.0 for r in hist["gasUsedRatio"])   # idle chain
+
+
+def test_failed_execution_still_moves_fee_market(rt):
+    """ADVICE r4: reverting/trapping executions consume gas the fee
+    side charged for; they must count toward block_gas so sustained
+    reverting load moves the EIP-1559 base fee upward too."""
+    addr = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    base = rt.state.get("evm", "block_gas", default=0)
+    with pytest.raises(DispatchError, match="Reverted"):
+        rt.apply_extrinsic("dev", "evm.call", addr,
+                           calldata(1, eth_address("bob"), 9_999_999))
+    after_revert = rt.state.get("evm", "block_gas", default=0)
+    assert after_revert > base
+    # an exceptional halt consumes the full limit
+    looper = initcode(asm(("label", "spin"), ("push_label", "spin"),
+                          "JUMP"))
+    la = rt.apply_extrinsic("dev", "evm.deploy", looper)
+    with pytest.raises(DispatchError, match="ExecutionFailed"):
+        rt.apply_extrinsic("dev", "evm.call", la, b"", 50_000)
+    assert rt.state.get("evm", "block_gas", default=0) \
+        >= after_revert + 50_000
+
+
+# -- value, CREATE/CREATE2, precompiles (VERDICT r4 Missing #2) -----------
+
+def test_value_transfer_and_selfbalance(rt):
+    vault = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        "SELFBALANCE", 0, "MSTORE", 32, 0, "RETURN")))
+    rt.apply_extrinsic("dev", "evm.deposit", 100 * D)
+    out = rt.apply_extrinsic("dev", "evm.call", vault, b"", 100_000,
+                             30)
+    # the callee observes its balance ALREADY credited
+    assert int.from_bytes(out, "big") == 30
+    assert rt.evm.balance_of(vault) == 30
+    assert rt.evm.balance("dev") == 100 * D - 30
+    # overdraw fails closed
+    with pytest.raises(DispatchError, match="InsufficientBalance"):
+        rt.apply_extrinsic("dev", "evm.call", vault, b"", 100_000,
+                           200 * D)
+
+
+def test_value_revert_returns_funds(rt):
+    bomb = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        0, 0, "REVERT")))
+    rt.apply_extrinsic("dev", "evm.deposit", 10 * D)
+    with pytest.raises(DispatchError, match="Reverted"):
+        rt.apply_extrinsic("dev", "evm.call", bomb, b"", 100_000, 5)
+    assert rt.evm.balance("dev") == 10 * D     # transfer unwound
+    assert rt.evm.balance_of(bomb) == 0
+
+
+def test_inner_call_forwards_value(rt):
+    """A CALL from bytecode carries value: the forwarder keeps half
+    and sends half to the address in calldata."""
+    sink = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm("STOP")))
+    fwd = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        0, 0, 0, 0,                    # outSize outOff inSize inOff
+        2, "CALLVALUE", "DIV",         # value = CALLVALUE / 2
+        0, "CALLDATALOAD",             # to
+        100_000, "CALL",
+        0, "MSTORE", 32, 0, "RETURN")))
+    rt.apply_extrinsic("dev", "evm.deposit", 10 * D)
+    out = rt.apply_extrinsic("dev", "evm.call", fwd, word(sink),
+                             200_000, 40)
+    assert int.from_bytes(out, "big") == 1     # inner call succeeded
+    assert rt.evm.balance_of(sink) == 20
+    assert rt.evm.balance_of(fwd) == 20
+    # value to a CODELESS address is a plain transfer, still a success
+    nobody = b"\xaa" * 20
+    rt.apply_extrinsic("dev", "evm.call", fwd, word(nobody),
+                       200_000, 6)
+    assert rt.evm.balance_of(nobody) == 3
+
+
+def test_create2_factory_at_predicted_address(rt):
+    """VERDICT r4 #2 done-criteria: a factory CREATE2-deploys a child
+    at the predicted address and calls it."""
+    from cess_tpu.chain.evm import create2_address
+
+    child_runtime = asm(7, 0, "MSTORE", 32, 0, "RETURN")
+    child_init = initcode(child_runtime)
+    factory = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        0x42,                          # salt
+        "CALLDATASIZE", 0,             # size, offset
+        0,                             # value
+        "CREATE2",
+        # call the new child and return ITS output
+        "DUP1", 0, "MSTORE",           # remember addr at mem 0
+        32, 32, 0, 0, 0,               # outSize=32 @32, no input
+        "DUP6", 100_000, "CALL", "POP",
+        64, 0, "RETURN")))             # [addr, child_out]
+    out = rt.apply_extrinsic("dev", "evm.call", factory, child_init,
+                             2_000_000)
+    predicted = create2_address(factory, (0x42).to_bytes(32, "big"),
+                                child_init)
+    assert out[12:32] == predicted
+    assert int.from_bytes(out[32:64], "big") == 7
+    assert rt.evm.code_at(predicted) == child_runtime
+    # and the child answers direct calls at that address
+    assert int.from_bytes(rt.evm.query(predicted, b""), "big") == 7
+    # redeploying the same (salt, init) collides -> CREATE2 fails (0)
+    out2 = rt.apply_extrinsic("dev", "evm.call", factory, child_init,
+                              2_000_000)
+    assert int.from_bytes(out2[:32], "big") == 0
+
+
+def test_create_from_bytecode(rt):
+    child_runtime = asm(9, 0, "MSTORE", 32, 0, "RETURN")
+    child_init = initcode(child_runtime)
+    factory = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        "CALLDATASIZE", 0,             # size, offset
+        0,                             # value
+        "CREATE",
+        0, "MSTORE", 32, 0, "RETURN")))
+    out = rt.apply_extrinsic("dev", "evm.call", factory, child_init,
+                             2_000_000)
+    addr = out[12:32]
+    assert int(out[:12].hex(), 16) == 0 and addr != b"\0" * 20
+    assert rt.evm.code_at(addr) == child_runtime
+    # two CREATEs from the same factory land at DIFFERENT addresses
+    out2 = rt.apply_extrinsic("dev", "evm.call", factory, child_init,
+                              2_000_000)
+    assert out2[12:32] != addr
+
+
+# a proxy that forwards calldata[32:] to the address in word 0 and
+# returns the call's first output word
+PC_PROXY = initcode(asm(
+    32, "CALLDATASIZE", "SUB",         # n = CDS - 32
+    "DUP1",
+    32, 0, "CALLDATACOPY",             # mem[0:n] = calldata[32:]
+    32, 0x100, "SWAP1", "SWAP2",       # [outSize=32, outOff=256, n]
+    0,                                 # inOff
+    0,                                 # value
+    0, "CALLDATALOAD",                 # to
+    100_000, "CALL",
+    "POP", 32, 0x100, "RETURN"))
+
+
+def test_precompiles_through_contract_call(rt):
+    """VERDICT r4 #2 done-criteria: a contract verifies an ecrecover
+    signature; sha256/ripemd160/identity answer at 0x2-0x4."""
+    import hashlib
+
+    from cess_tpu.crypto import secp256k1 as k1
+
+    proxy = rt.apply_extrinsic("dev", "evm.deploy", PC_PROXY)
+    # 0x1 ecrecover
+    secret = 0x5EC0_5EC0_5EC0
+    h = hashlib.sha256(b"authorize the thing").digest()
+    v, r, s = k1.sign(secret, h)
+    out = rt.evm.query(proxy, word(1) + h + word(v) + word(r) + word(s))
+    assert out[12:32] == k1.address_of(secret)
+    # a corrupted signature recovers NOTHING (empty returndata -> 0s)
+    out = rt.evm.query(
+        proxy, word(1) + h + word(v) + word(r ^ 1) + word(s))
+    assert out == b"\0" * 32 or out[12:32] != k1.address_of(secret)
+    # 0x2 sha256
+    out = rt.evm.query(proxy, word(2) + b"abc")
+    assert out == hashlib.sha256(b"abc").digest()
+    # 0x3 ripemd160 (left-padded to a word)
+    out = rt.evm.query(proxy, word(3) + b"abc")
+    assert out[12:] == hashlib.new("ripemd160", b"abc").digest()
+    # 0x4 identity
+    out = rt.evm.query(proxy, word(4) + b"echo" + b"\0" * 28)
+    assert out[:4] == b"echo"
+
+
+def test_eth_tx_lifecycle_rpc():
+    """VERDICT r4 Missing #1 done-criteria: ERC-20 deploy -> transfer
+    -> receipt -> logs purely through RPC (ref node/src/rpc.rs:229-328
+    Eth namespace: receipts, tx objects, blocks, estimateGas)."""
+    from cess_tpu import codec
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "rcpt", {"alice": spec.session_key("alice")})
+    srv = RpcServer(node, port=0)
+
+    def raw_tx(call, args):
+        return "0x" + codec.encode(sign_extrinsic(
+            spec.account_key("alice"), node.runtime.genesis_hash(),
+            "alice", node.runtime.system.nonce("alice"), call, args,
+            ())).hex()
+
+    # gas estimate for the deploy, via RPC, before sending anything
+    est = srv.handle("eth_estimateGas", [{"data": "0x"
+                                          + TOKEN_INIT.hex()}])
+    assert int(est, 16) > 0
+
+    # deploy through eth_sendRawTransaction; follow the hash to the
+    # receipt; pick up the contract address from it
+    h1 = srv.handle("eth_sendRawTransaction",
+                    [raw_tx("evm.deploy", (TOKEN_INIT,))])
+    assert srv.handle("eth_getTransactionReceipt", [h1]) is None  # pending
+    node.try_author(1) and node.commit_proposal()
+    rc1 = srv.handle("eth_getTransactionReceipt", [h1])
+    assert rc1["status"] == "0x1"
+    assert rc1["blockNumber"] == "0x1"
+    assert int(rc1["gasUsed"], 16) > 0
+    token = rc1["contractAddress"]
+    assert token and srv.handle("eth_getCode", [token]) \
+        == "0x" + TOKEN_RUNTIME.hex()
+
+    # transfer; the receipt carries the LOG1 with its topics/data.
+    # Estimate FIRST: the gas schedule is deterministic, so estimating
+    # against the same state the tx will execute in is exact.
+    bob_w = eth_address("bob")
+    est2 = srv.handle("eth_estimateGas",
+                      [{"from": "alice", "to": token,
+                        "data": "0x" + calldata(1, bob_w, 250).hex()}])
+    h2 = srv.handle("eth_sendRawTransaction",
+                    [raw_tx("evm.call",
+                            (bytes.fromhex(token[2:]),
+                             calldata(1, bob_w, 250)))])
+    node.try_author(2) and node.commit_proposal()
+    rc2 = srv.handle("eth_getTransactionReceipt", [h2])
+    assert rc2["status"] == "0x1" and rc2["to"] == token
+    assert len(rc2["logs"]) == 1
+    lg = rc2["logs"][0]
+    assert lg["address"] == token
+    assert lg["topics"] == ["0x" + word(bob_w).hex()]
+    assert int(lg["data"], 16) == 250
+    assert lg["transactionHash"] == h2
+
+    # the tx object round-trips: to/input/nonce/blockHash all present
+    tx2 = srv.handle("eth_getTransactionByHash", [h2])
+    assert tx2["to"] == token
+    assert tx2["input"] == "0x" + calldata(1, bob_w, 250).hex()
+    assert tx2["blockNumber"] == "0x2"
+    assert tx2["blockHash"] == rc2["blockHash"]
+
+    # blocks: hashes-only and full-object forms agree
+    blk = srv.handle("eth_getBlockByNumber", ["0x2", False])
+    assert blk["hash"] == rc2["blockHash"]
+    assert blk["transactions"] == [h2]
+    assert int(blk["gasUsed"], 16) == int(rc2["gasUsed"], 16)
+    full = srv.handle("eth_getBlockByNumber", ["0x2", True])
+    assert full["transactions"][0]["hash"] == h2
+    by_hash = srv.handle("eth_getBlockByHash", [blk["hash"], False])
+    assert by_hash["number"] == "0x2"
+    assert srv.handle("eth_getBlockByNumber", ["0x99"]) is None
+
+    # the pre-send estimate matches the measured receipt exactly
+    assert int(est2, 16) == int(rc2["gasUsed"], 16)
+
+    # a FAILED dispatch still yields a receipt, status 0x0 + error
+    h3 = srv.handle("eth_sendRawTransaction",
+                    [raw_tx("evm.call",
+                            (bytes.fromhex(token[2:]),
+                             calldata(1, bob_w, 10**9)))])
+    node.try_author(3) and node.commit_proposal()
+    rc3 = srv.handle("eth_getTransactionReceipt", [h3])
+    assert rc3["status"] == "0x0"
+    assert rc3["error"] == "evm.Reverted"
+    assert rc3["logs"] == []
+    # unknown hash -> null, bad hash -> error
+    assert srv.handle("eth_getTransactionReceipt",
+                      ["0x" + "ab" * 32]) is None
+    import pytest as _pytest
+
+    from cess_tpu.node.rpc import RpcError
+    with _pytest.raises(RpcError):
+        srv.handle("eth_getTransactionReceipt", ["0x1234"])
+
+
+def test_negative_value_cannot_mint(rt):
+    """Review-reproduced pot drain (fixed): a negative value passed
+    'have < amount' and CREDITED the attacker; the pot then paid the
+    minted balance out of other users' deposits."""
+    rt.apply_extrinsic("dev", "evm.deposit", 100 * D)   # fund the pot
+    sink = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm("STOP")))
+    with pytest.raises(DispatchError, match="InvalidAmount"):
+        rt.apply_extrinsic("bob", "evm.deploy", initcode(asm("STOP")),
+                           100_000, -50 * D)
+    with pytest.raises(DispatchError, match="Invalid"):
+        rt.apply_extrinsic("bob", "evm.call", sink, b"", 100_000,
+                           -50 * D)
+    assert rt.evm.balance("bob") == 0
+    with pytest.raises(DispatchError, match="InvalidAmount"):
+        rt.apply_extrinsic("bob", "evm.withdraw", 1)
+
+
+def test_ripemd160_fallback_matches_hashlib():
+    """The 0x3 precompile must be platform-independent: the pure
+    fallback and hashlib (when the OpenSSL build has it) agree, so
+    differently-built nodes can't diverge on a consensus result."""
+    import hashlib
+
+    from cess_tpu.crypto import ripemd160 as pure
+
+    for m in (b"", b"abc", b"message digest", b"a" * 1000,
+              bytes(range(256)) * 3):
+        assert pure.digest(m).hex() \
+            == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc".replace(
+                "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc",
+                hashlib.new("ripemd160", m).hexdigest())
+
+
+def test_delegatecall_to_precompile_moves_no_value(rt):
+    """Review-reproduced drain (fixed): DELEGATECALL to 0x1-0x4 with a
+    nonzero apparent callvalue must not transfer anything — mainnet
+    DELEGATECALL never moves value."""
+    # delegate calldata to 0x4 (identity), then return SELFBALANCE
+    dlg = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        32, 0x100, "CALLDATASIZE", 0, 4, 100_000, "DELEGATECALL",
+        "POP",
+        "SELFBALANCE", 0, "MSTORE", 32, 0, "RETURN")))
+    rt.apply_extrinsic("dev", "evm.deposit", 10 * D)
+    out = rt.apply_extrinsic("dev", "evm.call", dlg, b"xyz", 300_000,
+                             50)
+    # the contract still holds its full callvalue after delegating
+    assert int.from_bytes(out, "big") == 50
+    assert rt.evm.balance_of(dlg) == 50
+    assert rt.evm.balance_of((4).to_bytes(20, "big")) == 0

@@ -45,7 +45,8 @@ def test_upgrade_extrinsic_migrates_old_state():
     rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
     ev = rt.state.events_of("system", "MigrationApplied")
     assert {dict(e.data)["migration"] for e in ev} \
-        == {"staking-v2(1)", "tee_worker-v2(1)", "tee_worker-v3(0)"}
+        == {"staking-v2(1)", "tee_worker-v2(1)", "tee_worker-v3(0)",
+            "evm-v2(0)"}
     assert migrations.spec_version(s) == migrations.SPEC_VERSION
     assert migrations.storage_version(s, "staking") == 2
     assert s.get("staking", "prefs", "v9") == 0
@@ -234,3 +235,28 @@ def test_retired_bls_format_migration():
     assert migrations.storage_version(s, "tee_worker") == 3
     assert s.get("tee_worker", "retired_bls", "old-tee") == (b"\x01" * 96,)
     assert rt.tee_worker.bls_key_of("old-tee") == b"\x01" * 96
+
+
+def test_evm_ledger_migration_v2():
+    """Round-5 format change (review finding): EVM balances moved from
+    native-name keys + reserve backing to 20-byte-address keys + the
+    EVM_POT pot. Pre-upgrade deposits must stay withdrawable."""
+    from cess_tpu.chain.evm import EVM_POT, eth_address
+
+    rt = Runtime(RuntimeConfig(era_blocks=1000, genesis_spec_version=111))
+    s = rt.state
+    rt.fund("old", 100 * D)
+    # simulate a pre-upgrade deposit: str-keyed balance, reserve-backed
+    s.put("evm", "balance", "old", 40 * D)
+    s.put("evm", "nonce", "old", 3)
+    rt.balances.reserve("old", 40 * D)
+    rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
+    assert migrations.storage_version(s, "evm") == 2
+    assert s.get("evm", "balance", "old") is None
+    assert rt.evm.balance("old") == 40 * D
+    assert s.get("evm", "nonce", eth_address("old")) == 3
+    assert rt.balances.reserved("old") == 0
+    assert rt.balances.free(EVM_POT) == 40 * D
+    # the migrated deposit withdraws through the NEW pot path
+    rt.apply_extrinsic("old", "evm.withdraw", 40 * D)
+    assert rt.balances.free("old") == 100 * D
