@@ -311,7 +311,7 @@ class Proof:
     size at SIGMA_MAX (runtime/src/lib.rs:992) — ~1.06 KiB here,
     constant in the number of fragments."""
     mu: np.ndarray              # [sectors] uint32
-    sigma: tuple[int, int]      # F_p^2 element (two base-field limbs)
+    sigma: tuple[int, ...]      # F_p^limbs element (base-field limbs)
 
 
 def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
@@ -320,9 +320,14 @@ def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
     Fragments the miner no longer holds simply can't contribute — the
     fold then fails TEE verification (that's the audit)."""
     held = [h for h in owed if h in store]
+    # the limb WIDTH is a deployment parameter carried by the tags the
+    # TEE issued ([blocks, limbs]); hardwiring 2 here silently broke
+    # limbs=3 deployments (review finding, r05)
+    limbs = next(iter(tags.values())).shape[-1] if tags else podr2.LIMBS
     if not held:
         return codec.encode(Proof(
-            mu=np.zeros((podr2.SECTORS,), np.uint32), sigma=(0, 0)))
+            mu=np.zeros((podr2.SECTORS,), np.uint32),
+            sigma=(0,) * limbs))
     frags = np.stack([np.frombuffer(store[h], dtype=np.uint8)
                       for h in held])
     tag_arr = np.stack([tags[h] for h in held])
@@ -334,7 +339,7 @@ def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
                                       jnp.asarray(tag_arr), idx, nu, r)
     sigma = np.asarray(sigma)
     return codec.encode(Proof(mu=np.asarray(mu),
-                              sigma=(int(sigma[0]), int(sigma[1]))))
+                              sigma=tuple(int(v) for v in sigma)))
 
 
 class TeeAgent:
@@ -464,12 +469,13 @@ class TeeAgent:
                 and proof.mu.shape == (podr2.SECTORS,)
                 and proof.mu.dtype == np.uint32
                 and isinstance(proof.sigma, tuple)
-                and len(proof.sigma) == podr2.LIMBS
+                and len(proof.sigma) == self.key.limbs
                 and all(isinstance(s, int) and 0 <= s < pf.P
                         for s in proof.sigma)):
             return False
         if not owed:
-            return proof.sigma == (0, 0) and not proof.mu.any()
+            return proof.sigma == (0,) * self.key.limbs \
+                and not proof.mu.any()
         ids = np.stack([podr2.fragment_id_from_hash(h) for h in owed])
         r = podr2.aggregate_coeffs(seed, ids)
         ok = podr2.verify_aggregate(self.key, jnp.asarray(ids), self.blocks,

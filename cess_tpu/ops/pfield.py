@@ -90,6 +90,23 @@ def mulmod(a, b):
     return addmod(addmod(t_hi, mid), lo)
 
 
+def mulmod_u16(a, b):
+    """(a * b) mod p for a in [0, 2^16), b in [0, p).
+
+    The data-side fast path: PoDR2 packs fragment bytes two-per-element
+    (pack_bytes width 2), so the m operand of every MAC/proof multiply
+    is < 2^16 and its high limb is structurally zero — half of the
+    generic mulmod disappears. With a < 2^16:
+      a*b0 < 2^32 (one to_field), a*b1 <= (2^16-1)(2^15-1) < p (rot16
+      directly). When b is a constant (alpha), XLA hoists its limb
+      split, leaving ~2 multiplies + 2 reductions per element.
+    """
+    xp = _xp(a)
+    a = a.astype(xp.uint32)
+    b = b.astype(xp.uint32)
+    return addmod(to_field(a * (b & MASK16)), _rot16(a * (b >> 16)))
+
+
 def summod(x, axis=-1):
     """Exact modular sum along an axis; requires dim size <= 65535.
 

@@ -83,7 +83,8 @@ def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
         m = podr2.fragment_to_elems(shards.reshape(b * rows, n_local),
                                     sectors)                   # [F, bl_local, s]
         f_all = jax.vmap(
-            lambda i: podr2.prf_elems(key.prf_key, i, blocks_total))(frag_ids)
+            lambda i: podr2.prf_elems(key.prf_key, i, blocks_total,
+                                      key.limbs))(frag_ids)
         f_loc = jax.lax.dynamic_slice_in_dim(f_all, off, blocks_local, axis=1)
         tags = jax.vmap(podr2.tag_from_elems, in_axes=(None, 0, 0))(
             key.alpha, f_loc, m)                               # [F, bl_local, 2]

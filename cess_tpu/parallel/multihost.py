@@ -19,9 +19,11 @@ equivalent is:
   (``jax.make_array_from_process_local_data``) so no host ever holds
   the full batch — the 1 TiB corpus is ingested host-parallel.
 
-Single-process runs take the same code path (process_count == 1), so
-the whole flow is exercised on the 8-device CPU test mesh; the only
-multi-host-specific line is the distributed.initialize call.
+Exercised at BOTH process counts: single-process on the 8-device CPU
+test mesh (tests/test_mesh.py) and as two real OS processes running
+jax.distributed with gloo CPU collectives — init_multihost +
+make_array_from_process_local_data crossing an actual process boundary
+(tests/test_multiproc.py), the lines that differ in deployment.
 """
 from __future__ import annotations
 

@@ -83,3 +83,25 @@ def test_pack_unpack_roundtrip(width):
     # jax path identical
     np.testing.assert_array_equal(
         np.asarray(pf.pack_bytes(jnp.asarray(data), width)), elems)
+
+
+def test_mulmod_u16_matches_bigint():
+    """Data-side fast multiply: exhaustive edges + random pairs against
+    Python bigints (precondition a < 2^16, b < p)."""
+    import numpy as np
+
+    from cess_tpu.ops import pfield as pf
+
+    rng = np.random.default_rng(5)
+    a = np.concatenate([
+        np.array([0, 1, 2, 0xFFFF], dtype=np.uint32),
+        rng.integers(0, 1 << 16, 500, dtype=np.uint32)])
+    b = np.concatenate([
+        np.array([0, 1, pf.P - 1, (1 << 16) - 1, 1 << 16], dtype=np.uint32),
+        rng.integers(0, pf.P, 499, dtype=np.uint32)])
+    aa, bb = np.meshgrid(a, b)
+    got = pf.mulmod_u16(aa.ravel(), bb.ravel())
+    want = (aa.ravel().astype(object) * bb.ravel().astype(object)) % pf.P
+    np.testing.assert_array_equal(got.astype(object), want)
+    # and agrees with the generic mulmod
+    np.testing.assert_array_equal(got, pf.mulmod(aa.ravel(), bb.ravel()))

@@ -34,6 +34,6 @@ def test_generator_scenarios_cover_surface():
     spec = importlib.util.spec_from_file_location("gen_weights", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    covered = set(mod.scenarios()) | {"election.submit_solution"}
+    covered = set(mod.scenarios()) | set(mod.ELECTION_CALLS)
     missing = set(DISPATCHABLE) - covered
     assert not missing, f"no measurement scenario for {sorted(missing)}"
