@@ -275,9 +275,23 @@ def _storage_key(k) -> bytes:
     return hashlib.sha256(codec.encode(k)).digest()
 
 
+def code_hash(code: tuple) -> bytes:
+    """THE canonical serialized bytecode identity: sha256 of the codec
+    encoding of the instruction tuple. The codec encoding is the wire
+    format third-party toolchains target (deterministic, versioned,
+    schema-checked on decode), so a code hash names exactly one
+    byte-identical program on every replica."""
+    from .. import codec
+
+    return hashlib.sha256(b"cvm-code:" + codec.encode(code)).digest()
+
+
 class Contracts:
-    """The pallet boundary: deploy/call/query over the VM, matching
-    evm.py's surface shape (runtime/src/lib.rs:1191-1207 role)."""
+    """The pallet boundary: upload/deploy/instantiate/call/query over
+    the VM, matching evm.py's surface shape + pallet-contracts'
+    code-hash model (runtime/src/lib.rs:1191-1207: upload_code,
+    instantiate_with_code, instantiate — code stored ONCE per hash,
+    contracts point at it)."""
 
     def __init__(self, state: State):
         self.state = state
@@ -295,23 +309,58 @@ class Contracts:
                         and isinstance(i[0], str) for i in code)):
             raise DispatchError("contracts.InvalidCode")
 
+    # -- code store (pallet-contracts upload_code / CodeStorage) -------------
+    def upload_code(self, who: str, code: tuple) -> bytes:
+        """Store a program under its canonical hash (dedup: a second
+        upload of identical code is a no-op returning the same hash).
+        Returns the code hash for later instantiate()."""
+        self._check_code(code)
+        h = code_hash(code)
+        if not self.state.contains(PALLET, "code_store", h):
+            self.state.put(PALLET, "code_store", h, code)
+            self.state.deposit_event(PALLET, "CodeStored", who=who,
+                                     code_hash=h, instrs=len(code))
+        return h
+
+    def code_by_hash(self, h: bytes):
+        return self.state.get(PALLET, "code_store", h)
+
+    def _new_address(self, who: str) -> bytes:
+        nonce = self.state.get(PALLET, "nonce", who, default=0)
+        self.state.put(PALLET, "nonce", who, nonce + 1)
+        return hashlib.sha256(b"cvm-create:" + who.encode()
+                              + nonce.to_bytes(8, "little")).digest()[:20]
+
     def deploy(self, who: str, code: tuple) -> bytes:
-        """Store a program; constructors are an explicit follow-up
+        """instantiate_with_code: upload (deduped) + instantiate in
+        one dispatch; constructors are an explicit follow-up
         ``call(addr, "init", ...)`` by convention (keeps deploy cost
         independent of program behavior, so no gas parameter).
         Returns the address."""
-        self._check_code(code)
-        nonce = self.state.get(PALLET, "nonce", who, default=0)
-        self.state.put(PALLET, "nonce", who, nonce + 1)
-        addr = hashlib.sha256(b"cvm-create:" + who.encode()
-                              + nonce.to_bytes(8, "little")).digest()[:20]
-        self.state.put(PALLET, "code", addr, code)
+        h = self.upload_code(who, code)
+        return self._instantiate(who, h, len(code))
+
+    def instantiate(self, who: str, h: bytes) -> bytes:
+        """Deploy-by-hash against previously uploaded code — the wire
+        carries 32 bytes instead of the whole program."""
+        code = self.code_by_hash(h) if isinstance(h, bytes) else None
+        if code is None:
+            raise DispatchError("contracts.CodeNotFound")
+        return self._instantiate(who, h, len(code))
+
+    def _instantiate(self, who: str, h: bytes, instrs: int) -> bytes:
+        addr = self._new_address(who)
+        self.state.put(PALLET, "code", addr, h)   # hash, not the body
         self.state.deposit_event(PALLET, "Deployed", who=who,
-                                 address=addr, instrs=len(code))
+                                 address=addr, code_hash=h,
+                                 instrs=instrs)
         return addr
 
     def code_at(self, address: bytes):
-        return self.state.get(PALLET, "code", address)
+        ref = self.state.get(PALLET, "code", address)
+        if isinstance(ref, bytes):                # hash indirection
+            return self.code_by_hash(ref)
+        return ref                                # pre-v2 inline body
 
     def call(self, who: str, address: bytes, method: str,
              args: tuple = (), gas_limit: int = DEFAULT_GAS):

@@ -101,12 +101,51 @@ def _migrate_evm_v2(state: State) -> int:
     return n
 
 
+def _migrate_staking_v3(state: State) -> int:
+    """Build the VoterList bags index (round-5) for validators that
+    predate it; top_stakers falls back to the flat set until this
+    runs, so an un-upgraded restart keeps electing correctly."""
+    from .staking import PALLET as STAKING, Staking
+
+    n = 0
+    for who in state.get(STAKING, "validators", default=()):
+        if state.get(STAKING, "bag_of", who) is not None:
+            continue
+        b = Staking.bag_index(state.get(STAKING, "bond", who, default=0))
+        state.put(STAKING, "bag", b,
+                  state.get(STAKING, "bag", b, default=()) + (who,))
+        state.put(STAKING, "bag_of", who, b)
+        state.put(STAKING, "bag_count",
+                  state.get(STAKING, "bag_count", default=0) + 1)
+        n += 1
+    return n
+
+
+def _migrate_contracts_v2(state: State) -> int:
+    """Contracts code moved behind the canonical code-hash store
+    (round-5): inline per-address bodies become hash references with
+    the body stored once per hash (pallet-contracts CodeStorage)."""
+    from .contracts import code_hash
+
+    n = 0
+    for (addr,), code in list(state.iter_prefix("contracts", "code")):
+        if isinstance(code, tuple):
+            h = code_hash(code)
+            if not state.contains("contracts", "code_store", h):
+                state.put("contracts", "code_store", h, code)
+            state.put("contracts", "code", addr, h)
+            n += 1
+    return n
+
+
 # (pallet, target_version, fn) — fn returns #entries transformed
 MIGRATIONS = [
     ("staking", 2, _migrate_staking_v2),
+    ("staking", 3, _migrate_staking_v3),
     ("tee_worker", 2, _migrate_tee_worker_v2),
     ("tee_worker", 3, _migrate_tee_worker_v3),
     ("evm", 2, _migrate_evm_v2),
+    ("contracts", 2, _migrate_contracts_v2),
 ]
 
 

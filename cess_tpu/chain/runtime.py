@@ -77,14 +77,15 @@ SIGNED_CALLS = {
     "staking.bond", "staking.unbond", "staking.withdraw_unbonded",
     "staking.validate", "staking.chill", "staking.nominate",
     "im_online.heartbeat",
-    "election.submit_solution",
+    "election.submit_solution", "election.submit_unsigned",
     "council.propose", "council.vote", "council.close",
     "technical_committee.propose", "technical_committee.vote",
     "technical_committee.close",
     "treasury.propose_spend", "treasury.propose_bounty",
     "sminer.faucet",
     "evm.deposit", "evm.withdraw", "evm.deploy", "evm.call",
-    "contracts.deploy", "contracts.call",
+    "contracts.deploy", "contracts.call", "contracts.upload_code",
+    "contracts.instantiate",
     "assets.create", "assets.destroy", "assets.set_team",
     "assets.transfer_ownership",
     "assets.set_metadata", "assets.mint", "assets.burn",
@@ -126,6 +127,11 @@ FEELESS = {
     "offences.report_equivocation",
     # ref im-online heartbeats are validated unsigned operational txs
     "im_online.heartbeat",
+    # OCW-mined election solutions ride as validated unsigned txs in
+    # the reference (lib.rs:834-863); admission fully verifies the
+    # session signature + exact score, so the feeless lane can't be
+    # spammed with junk
+    "election.submit_unsigned",
 }
 
 
@@ -150,6 +156,7 @@ HAND_WEIGHTS = {
     "storage_handler.expansion_space": 10,
     "storage_handler.renewal_space": 10,
     "contracts.call": 20, "contracts.deploy": 20,
+    "contracts.upload_code": 10,
 }
 CALL_WEIGHTS = {call: 10 * w for call, w in GENERATED_WEIGHTS.items()}
 for _call, _floor in HAND_WEIGHTS.items():

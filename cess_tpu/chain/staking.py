@@ -72,6 +72,7 @@ class Staking:
             raise DispatchError("staking.InvalidAmount")
         self.balances.reserve(who, amount)
         self.state.put(PALLET, "bond", who, self.bonded(who) + amount)
+        self._bags_update(who)
         self.state.deposit_event(PALLET, "Bonded", who=who, amount=amount)
 
     def unbond(self, who: str, amount: int) -> None:
@@ -97,6 +98,7 @@ class Staking:
             chunks = chunks + ((amount, unlock_era),)
         self.state.put(PALLET, "unlocking", who, chunks)
         self.state.put(PALLET, "bond", who, b - amount)
+        self._bags_update(who)
         self.state.deposit_event(PALLET, "Unbonded", who=who,
                                  amount=amount, unlock_era=unlock_era)
 
@@ -141,6 +143,7 @@ class Staking:
         vals = self.validators()
         if who not in vals:
             self.state.put(PALLET, "validators", vals + (who,))
+        self._bags_update(who)
 
     def commission(self, who: str) -> int:
         return self.state.get(PALLET, "prefs", who, default=0)
@@ -151,10 +154,80 @@ class Staking:
         if who in vals:
             self.state.put(PALLET, "validators",
                            tuple(v for v in vals if v != who))
+            self._bags_update(who)
         self.state.delete(PALLET, "nomination", who)
 
     def validators(self) -> tuple[str, ...]:
         return self.state.get(PALLET, "validators", default=())
+
+    # -- VoterList (bags-list) analog -----------------------------------------
+    # The reference keeps a semi-sorted on-chain voter index
+    # (pallet_bags_list as VoterList, runtime/src/lib.rs:1512) so the
+    # election snapshot never scans every account. Same structure
+    # here: validators live in log2-stake BAGS — ("bag", b) holds an
+    # insertion-ordered tuple, ("bag_of", who) its index — updated
+    # incrementally on every bond/unbond/slash/validate/chill, and the
+    # election snapshot walks bags from the heaviest down
+    # (top_stakers), stopping at its bound instead of scoring the full
+    # candidate set.
+
+    @staticmethod
+    def bag_index(stake: int) -> int:
+        return stake.bit_length()        # log2 buckets, exact enough
+
+    def _bags_update(self, who: str) -> None:
+        """Re-place ``who`` in the stake-ordered index. Call after any
+        change to its bond or validator-set membership; no-op when the
+        bag is already right (same-bag bond moves keep position, like
+        the reference's lazy rebag)."""
+        cur = self.state.get(PALLET, "bag_of", who)
+        want = self.bag_index(self.bonded(who)) \
+            if who in self.validators() else None
+        if cur == want:
+            return
+        if cur is not None:
+            members = tuple(m for m in self.state.get(
+                PALLET, "bag", cur, default=()) if m != who)
+            if members:
+                self.state.put(PALLET, "bag", cur, members)
+            else:
+                self.state.delete(PALLET, "bag", cur)
+        count = self.state.get(PALLET, "bag_count", default=0)
+        if want is None:
+            self.state.delete(PALLET, "bag_of", who)
+            self.state.put(PALLET, "bag_count", count - 1)
+        else:
+            self.state.put(PALLET, "bag", want, self.state.get(
+                PALLET, "bag", want, default=()) + (who,))
+            self.state.put(PALLET, "bag_of", who, want)
+            if cur is None:
+                self.state.put(PALLET, "bag_count", count + 1)
+
+    def top_stakers(self, limit: int) -> list[str]:
+        """Up to ``limit`` validators, heaviest bags first (within a
+        bag: insertion order — semi-sorted, like the reference's
+        VoterList). A PARTIAL index (an old snapshot before the
+        staking-v3 migration ran — even one where post-restart staking
+        ops already indexed a few validators) falls back to the plain
+        set: the bag_count counter vs the roster length detects it in
+        O(1), so an un-upgraded restart can never hide incumbents from
+        the election snapshot (review-caught on the empty-only check)."""
+        vals = self.validators()
+        if self.state.get(PALLET, "bag_count", default=0) != len(vals):
+            # pre-migration fallback must still rank by stake — a
+            # registration-order truncation would hide whales from the
+            # snapshot (review-caught); O(V log V) only in this window
+            return sorted(vals, key=lambda v: (-self.bonded(v), v))[:limit]
+        bags = sorted(((k[0], v) for k, v in
+                       self.state.iter_prefix(PALLET, "bag")),
+                      reverse=True)
+        out: list[str] = []
+        for _, members in bags:
+            for who in members:
+                out.append(who)
+                if len(out) >= limit:
+                    return out
+        return out
 
     # -- nominations (MaxNominations = 1, runtime/src/lib.rs:378) ---------------
     def nominate(self, who: str, target: str) -> None:
@@ -288,6 +361,7 @@ class Staking:
                 self.state.delete(PALLET, "unlocking", who)
         if taken:
             self.balances.slash_reserved(who, taken, TREASURY)
+            self._bags_update(who)
         return taken
 
     def _slash_one(self, who: str, permill: int) -> int:

@@ -43,7 +43,8 @@ def main(argv=None) -> int:
     ap.add_argument("subcommand", nargs="?", default="run",
                     choices=["run", "build-spec", "key", "sign",
                              "verify", "export-blocks", "import-blocks",
-                             "revert", "check-block"])
+                             "revert", "check-block", "vanity",
+                             "benchmark"])
     ap.add_argument("--dev", action="store_true",
                     help="single-authority dev chain")
     ap.add_argument("--chain", default="dev",
@@ -82,6 +83,11 @@ def main(argv=None) -> int:
                          "slot numbers aligned")
     ap.add_argument("--slot-time", type=float, default=6.0,
                     help="seconds per slot (TCP mode; ref block time 6s)")
+    ap.add_argument("--pattern", default="",
+                    help="hex prefix the public key must start with "
+                         "(vanity)")
+    ap.add_argument("--reps", type=int, default=20,
+                    help="dispatches per benchmark sample")
     args = ap.parse_args(argv)
 
     def unhex(s: str) -> bytes:
@@ -105,6 +111,58 @@ def main(argv=None) -> int:
                             unhex(args.signature))
         print(json.dumps({"valid": bool(ok)}))
         return 0 if ok else 1
+
+    if args.subcommand == "vanity":
+        # the reference's `key vanity` (node/src/cli.rs:23-70 via
+        # sc-cli): grind seeds until the public key starts with the
+        # requested hex prefix
+        want = args.pattern.lower().removeprefix("0x")
+        if not want or any(c not in "0123456789abcdef" for c in want):
+            print("--pattern must be non-empty hex", file=sys.stderr)
+            return 1
+        if len(want) > 6:
+            print("--pattern longer than 6 hex digits would grind for "
+                  "hours; refusing", file=sys.stderr)
+            return 1
+        i = 0
+        while True:
+            seed = f"{args.suri}/{i}".encode()
+            key = ed25519.SigningKey.generate(seed)
+            if key.public.hex().startswith(want):
+                print(json.dumps({"public": "0x" + key.public.hex(),
+                                  "seed": seed.decode(),
+                                  "tries": i + 1}))
+                return 0
+            i += 1
+
+    if args.subcommand == "benchmark":
+        # the `benchmark` subcommand role (node/src/cli.rs:23-70):
+        # measure this host's dispatch + block-execution rates against
+        # the weight unit so operators can judge whether their machine
+        # keeps up with the 6 s slot budget
+        import statistics
+        import time as _time
+
+        from ..chain.runtime import Runtime, RuntimeConfig
+
+        rt = Runtime(RuntimeConfig(era_blocks=100_000))
+        rt.fund("bench-a", 10 ** 24)
+        times = []
+        for i in range(max(args.reps, 5)):
+            t0 = _time.perf_counter()
+            rt.apply_extrinsic("bench-a", "balances.transfer",
+                               f"bench-b{i}", 10 ** 12)
+            times.append(_time.perf_counter() - t0)
+        unit_us = statistics.median(times) * 1e6
+        t0 = _time.perf_counter()
+        rt.advance_blocks(50)
+        empty_block_us = (_time.perf_counter() - t0) / 50 * 1e6
+        print(json.dumps({
+            "weight_unit_us": round(unit_us, 2),
+            "empty_block_us": round(empty_block_us, 2),
+            "transfers_per_6s_block": int(6e6 / unit_us),
+        }))
+        return 0
 
     spec = dev_spec() if args.dev else _load_spec(args.chain,
                                                   args.validators)

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
+import time
 
 from .. import codec
 from ..crypto import ed25519
@@ -37,6 +38,13 @@ K = 8          # bucket size == store/lookup replication
 ALPHA = 3      # lookup concurrency (serialized per round here)
 ID_BITS = 256
 STORE_CAP = 512
+# stored records expire after TTL unless republished (libp2p Kademlia's
+# provider-record expiry role: a retired authority's address does not
+# linger forever); publishers re-publish every ~10 slots, far inside it
+RECORD_TTL = 600.0
+# a bucket untouched this long gets a synthetic-target lookup (Kademlia
+# bucket refresh: keeps far buckets populated through churn)
+BUCKET_REFRESH_INTERVAL = 60.0
 RECORD_SIGNING_CONTEXT = b"cess-tpu/authority-record-v1:"
 
 
@@ -97,13 +105,18 @@ class Kademlia:
     current set)."""
 
     def __init__(self, self_contact: Contact, verify_record,
-                 k: int = K):
+                 k: int = K, record_ttl: float = RECORD_TTL,
+                 refresh_interval: float = BUCKET_REFRESH_INTERVAL):
         self.self_contact = self_contact
         self.self_id = self_contact.node_id()
         self.verify_record = verify_record
         self.k = k
+        self.record_ttl = record_ttl
+        self.refresh_interval = refresh_interval
         self._buckets: list[list[Contact]] = [[] for _ in range(ID_BITS)]
+        self._touched: list[float] = [time.time()] * ID_BITS
         self._store: dict[bytes, AuthorityRecord] = {}
+        self._stored_at: dict[bytes, float] = {}
         self._lock = threading.Lock()
 
     # -- routing table ------------------------------------------------------
@@ -123,6 +136,8 @@ class Kademlia:
             b = self._bucket_of(c.node_id())
             if b is None:
                 return
+            d = distance(self.self_id, c.node_id())
+            self._touched[d.bit_length() - 1] = time.time()
             for i, have in enumerate(b):
                 if have.port == c.port:
                     del b[i]
@@ -141,24 +156,69 @@ class Kademlia:
                       key=lambda c: distance(c.node_id(), key))[:n or self.k]
 
     # -- record store -------------------------------------------------------
-    def store_record(self, rec) -> bool:
-        """Verify + keep (newest serial wins); False if rejected."""
+    def store_record(self, rec, now: float | None = None) -> bool:
+        """Verify + keep (newest serial wins); False if rejected. A
+        re-store of the SAME record refreshes its TTL clock (that is
+        what periodic republication is for)."""
         if not isinstance(rec, AuthorityRecord) \
                 or not self.verify_record(rec):
             return False
+        now = time.time() if now is None else now
         key = record_key(rec.authority)
         with self._lock:
+            self._expire_locked(now)
             have = self._store.get(key)
             if have is not None and have.serial >= rec.serial:
-                return have.serial == rec.serial and have == rec
+                if have.serial == rec.serial and have == rec:
+                    self._stored_at[key] = now    # republish: new TTL
+                    return True
+                return False
             if have is None and len(self._store) >= STORE_CAP:
                 return False
             self._store[key] = rec
+            self._stored_at[key] = now
         return True
 
-    def record(self, key: bytes) -> AuthorityRecord | None:
+    def record(self, key: bytes,
+               now: float | None = None) -> AuthorityRecord | None:
+        now = time.time() if now is None else now
         with self._lock:
+            at = self._stored_at.get(key)
+            if at is not None and now - at > self.record_ttl:
+                del self._store[key]
+                del self._stored_at[key]
+                return None
             return self._store.get(key)
+
+    def expire(self, now: float | None = None) -> int:
+        """Drop every record past its TTL; returns how many went."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return self._expire_locked(now)
+
+    def _expire_locked(self, now: float) -> int:
+        stale = [k for k, at in self._stored_at.items()
+                 if now - at > self.record_ttl]
+        for k in stale:
+            del self._store[k]
+            del self._stored_at[k]
+        return len(stale)
+
+    def refresh_targets(self, now: float | None = None) -> list[bytes]:
+        """One synthetic lookup target per STALE non-empty bucket (id
+        with exactly that bucket's bit differing from ours — any
+        lookup toward it exercises the bucket). Marks returned buckets
+        touched; the caller runs the lookups."""
+        now = time.time() if now is None else now
+        out = []
+        self_int = int.from_bytes(self.self_id, "big")
+        with self._lock:
+            for i, b in enumerate(self._buckets):
+                if b and now - self._touched[i] > self.refresh_interval:
+                    out.append((self_int ^ (1 << i)).to_bytes(
+                        ID_BITS // 8, "big"))
+                    self._touched[i] = now
+        return out
 
     # -- request handling ---------------------------------------------------
     def handle(self, req):

@@ -224,6 +224,7 @@ class NodeService:
         self._dht_listener: socket.socket | None = None
         self._publish_serial = 0
         self._next_publish = 0.0
+        self._next_dht_maint = 0.0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -660,6 +661,13 @@ class NodeService:
                 self._next_publish = now + 10 * self.slot_time
                 self._publishing = True
                 self._spawn(self._publish_once)
+            # DHT upkeep: record expiry + stale-bucket refresh lookups
+            # (libp2p Kademlia's periodic maintenance), off this thread
+            if now >= self._next_dht_maint \
+                    and not getattr(self, "_dht_mainting", False):
+                self._next_dht_maint = now + 20 * self.slot_time
+                self._dht_mainting = True
+                self._spawn(self._dht_maintenance)
 
     # -- authority discovery (Kademlia; service.rs:508-537 role) -------------
     def _verify_record(self, rec: "dht_mod.AuthorityRecord") -> bool:
@@ -772,6 +780,18 @@ class NodeService:
             self.publish_authorities()
         finally:
             self._publishing = False
+
+    def _dht_maintenance(self) -> None:
+        try:
+            if self.kad is None:
+                return
+            self.kad.expire()
+            for target in self.kad.refresh_targets():
+                if self._stop.is_set():
+                    return
+                self._iter_lookup(target, want_value=False)
+        finally:
+            self._dht_mainting = False
 
     def publish_authorities(self) -> None:
         """Publish a signed address record for every authority whose

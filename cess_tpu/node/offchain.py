@@ -239,10 +239,11 @@ class MinerAgent:
         (audit/src/lib.rs:430-479) with honest wire sizing."""
         seed = b"".join(ch.net.randoms)
         snap = next(s for s in ch.miners if s.miner == self.account)
+        limbs = self.pipeline.podr2_key.limbs
         service = build_proof(seed, list(snap.service_frags), self.store,
-                              self.tags)
+                              self.tags, limbs=limbs)
         idle = build_proof(seed, list(snap.fillers), self.filler_store,
-                           self.filler_tags)
+                           self.filler_tags, limbs=limbs)
         node.submit_extrinsic(self.account, "audit.submit_proof",
                               idle, service)
 
@@ -314,16 +315,23 @@ class Proof:
     sigma: tuple[int, ...]      # F_p^limbs element (base-field limbs)
 
 
-def build_proof(seed: bytes, owed: list[bytes], store: dict[bytes, bytes],
-                tags: dict[bytes, np.ndarray]) -> bytes:
+def build_proof(seed: bytes, owed: list[bytes],
+                store: dict[bytes, bytes],
+                tags: dict[bytes, np.ndarray],
+                limbs: int | None = None) -> bytes:
     """Miner-side: aggregated proof over the owed set, as wire bytes.
     Fragments the miner no longer holds simply can't contribute — the
     fold then fails TEE verification (that's the audit)."""
     held = [h for h in owed if h in store]
-    # the limb WIDTH is a deployment parameter carried by the tags the
-    # TEE issued ([blocks, limbs]); hardwiring 2 here silently broke
-    # limbs=3 deployments (review finding, r05)
-    limbs = next(iter(tags.values())).shape[-1] if tags else podr2.LIMBS
+    # the limb WIDTH is a deployment parameter: callers pass it from
+    # their PoDR2 key (hardwiring 2 broke limbs=3 deployments; and an
+    # EMPTY tags map must not silently fall back to the module default
+    # — a fillerless miner in a limbs=3 deployment would emit a
+    # wrong-width zero sigma and fail an audit it should pass; both
+    # review-caught, r05)
+    if limbs is None:
+        limbs = next(iter(tags.values())).shape[-1] if tags \
+            else podr2.LIMBS
     if not held:
         return codec.encode(Proof(
             mu=np.zeros((podr2.SECTORS,), np.uint32),
@@ -496,8 +504,13 @@ class ValidatorOcw:
         self.account = account
         self.session_key = session_key
         self._proposed_at: int = -1
+        self._mined_era: int = -1
 
     def on_block(self, node: Node) -> None:
+        self._maybe_propose_challenge(node)
+        self._maybe_mine_election(node)
+
+    def _maybe_propose_challenge(self, node: Node) -> None:
         from ..chain.audit import SESSION_SIGNING_CONTEXT, Audit
 
         rt = node.runtime
@@ -515,3 +528,44 @@ class ValidatorOcw:
         node.submit_extrinsic(self.account, "audit.save_challenge_info",
                               net, miners, sig)
         self._proposed_at = rt.state.block
+
+    def _maybe_mine_election(self, node: Node) -> None:
+        """The reference's unsigned election phase (lib.rs:834-863):
+        during the OCW window each validator mines a solution locally
+        and submits it feeless; on-chain admission verifies the
+        session signature and the exact score (election.py)."""
+        from .consensus import elect_validators
+
+        rt = node.runtime
+        el = rt.election
+        era = rt.state.block // el.era_blocks
+        if not el.in_unsigned_phase() or era == self._mined_era:
+            return
+        if self.account not in rt.staking.validators():
+            return
+        # mine over the SAME stake-bounded snapshot admission verifies
+        # against (election._candidates) — the full roster would pick
+        # out-of-snapshot validators and every submission would bounce
+        # (review-caught)
+        stakes = el._candidates()
+        credits = rt.credit.credits()
+        maxv = el.max_validators or rt.config.max_validators
+        solution = elect_validators(stakes, credits, maxv)
+        if not solution:
+            return
+        from ..chain.election import score_of
+
+        score = score_of(solution, stakes, credits)
+        queued = rt.state.get("election", "best_unsigned", default=None)
+        if queued is not None and queued[2] >= score:
+            self._mined_era = era       # someone already queued as good
+            return
+        sig = self.session_key.sign(
+            el.unsigned_payload(tuple(solution), score, self.account))
+        try:
+            node.submit_extrinsic(self.account,
+                                  "election.submit_unsigned",
+                                  tuple(solution), score, sig)
+        except DispatchError:
+            pass   # raced by a peer's equal solution: fine
+        self._mined_era = era

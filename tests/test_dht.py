@@ -119,3 +119,57 @@ def test_handle_find_store_value_flow():
     # malformed requests answer structured errors
     assert a.handle(("bogus", None, None))[0] == "err"
     assert a.handle("not-a-tuple")[0] == "err"
+
+
+def test_record_ttl_and_republish():
+    """VERDICT r4 Next #10: stored records expire after the TTL unless
+    republished; a republish of the same serial refreshes the clock."""
+    from cess_tpu.node import dht
+
+    kad = dht.Kademlia(dht.Contact(port=1000, dht_port=1001),
+                       verify_record=lambda r: True, record_ttl=50.0)
+    rec = dht.AuthorityRecord(authority="v0", port=1000, dht_port=1001,
+                              serial=1, signature=b"")
+    key = dht.record_key("v0")
+    assert kad.store_record(rec, now=100.0)
+    assert kad.record(key, now=140.0) == rec          # inside TTL
+    # republishing the SAME record refreshes the clock
+    assert kad.store_record(rec, now=140.0)
+    assert kad.record(key, now=185.0) == rec          # 45s since refresh
+    assert kad.record(key, now=195.0) is None         # 55s: expired
+    # expired means re-storable from scratch (no stale-serial block)
+    assert kad.store_record(rec, now=200.0)
+    # sweep drops expired entries wholesale
+    assert kad.expire(now=300.0) == 1
+    assert kad.record(key, now=300.0) is None
+
+
+def test_bucket_refresh_targets():
+    """Stale non-empty buckets yield one synthetic target each, whose
+    lookup would exercise exactly that bucket; fresh buckets yield
+    nothing; returned buckets are marked touched."""
+    from cess_tpu.node import dht
+
+    kad = dht.Kademlia(dht.Contact(port=2000, dht_port=2001),
+                       verify_record=lambda r: True,
+                       refresh_interval=30.0)
+    for port in (2002, 2003, 2004, 2005):
+        kad.note(dht.Contact(port=port, dht_port=port + 1))
+    assert kad.refresh_targets(now=time_now()) == []   # all fresh
+    stale_now = time_now() + 100.0
+    targets = kad.refresh_targets(now=stale_now)
+    assert targets
+    occupied = {dht.distance(kad.self_id,
+                             c.node_id()).bit_length() - 1
+                for c in kad.contacts()}
+    for t in targets:
+        b = dht.distance(kad.self_id, t).bit_length() - 1
+        assert b in occupied
+    # marked touched: an immediate second sweep is empty
+    assert kad.refresh_targets(now=stale_now) == []
+
+
+def time_now():
+    import time
+
+    return time.time()

@@ -314,3 +314,23 @@ def test_offchain_proof_wire_respects_limb_width(limbs):
     wrong = codec.encode(Proof(mu=np.zeros((podr2.SECTORS,), np.uint32),
                                sigma=(0,) * (limbs + 1)))
     assert not TeeAgent._verify(tee, wrong, [], b"limb-wire", idx, nu)
+
+
+def test_fillerless_miner_proof_width_limbs3():
+    """Review-caught (r05): with an EMPTY tags map the proof width must
+    come from the caller's key, not the module default — a fillerless
+    miner in a limbs=3 deployment otherwise emits a 2-limb zero sigma
+    and fails an audit it should pass."""
+    from cess_tpu import codec
+    from cess_tpu.node.offchain import TeeAgent, build_proof
+
+    params = podr2.Podr2Params(limbs=3)
+    key = podr2.Podr2Key.generate(31, params)
+    blob = build_proof(b"seed", [], {}, {}, limbs=3)
+    proof = codec.decode(blob)
+    assert len(proof.sigma) == 3
+    tee = object.__new__(TeeAgent)
+    tee.key = key
+    tee.blocks = 16
+    idx, nu = podr2.gen_challenge(b"seed", 16)
+    assert TeeAgent._verify(tee, blob, [], b"seed", idx, nu)

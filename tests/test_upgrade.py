@@ -45,10 +45,10 @@ def test_upgrade_extrinsic_migrates_old_state():
     rt.apply_extrinsic("root", "system.apply_runtime_upgrade")
     ev = rt.state.events_of("system", "MigrationApplied")
     assert {dict(e.data)["migration"] for e in ev} \
-        == {"staking-v2(1)", "tee_worker-v2(1)", "tee_worker-v3(0)",
-            "evm-v2(0)"}
+        == {"staking-v2(1)", "staking-v3(1)", "tee_worker-v2(1)",
+            "tee_worker-v3(0)", "evm-v2(0)", "contracts-v2(0)"}
     assert migrations.spec_version(s) == migrations.SPEC_VERSION
-    assert migrations.storage_version(s, "staking") == 2
+    assert migrations.storage_version(s, "staking") == 3
     assert s.get("staking", "prefs", "v9") == 0
     assert s.get("tee_worker", "ias_pins") == ()
     # idempotent: a second activation migrates nothing new

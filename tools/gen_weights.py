@@ -510,6 +510,19 @@ def scenarios():
                          (("input",), ("push", 1), ("index",),
                           ("return",)))
 
+    def contracts_upload_code(rt):
+        # fresh body per rep: dedup must not shortcut the measurement
+        return "alice", ("contracts.upload_code",
+                         (("push", nxt()), ("pop",), ("input",),
+                          ("push", 1), ("index",), ("return",)))
+
+    def contracts_instantiate(rt):
+        h = rt.apply_extrinsic(
+            "alice", "contracts.upload_code",
+            (("push", 90_000 + nxt()), ("pop",), ("input",),
+             ("push", 1), ("index",), ("return",)))
+        return "alice", ("contracts.instantiate", h)
+
     def contracts_call(rt):
         if "caddr" not in counter:
             counter["caddr"] = rt.apply_extrinsic(
@@ -812,6 +825,8 @@ def scenarios():
         "evm.call": evm_call,
         "contracts.deploy": contracts_deploy,
         "contracts.call": contracts_call,
+        "contracts.upload_code": contracts_upload_code,
+        "contracts.instantiate": contracts_instantiate,
         "assets.create": assets_create,
         "assets.destroy": assets_destroy,
         "assets.set_team": assets_set_team,
@@ -841,7 +856,7 @@ def scenarios():
 # calls measured by election_scenarios() rather than scenarios() —
 # the ONE list both the coverage check in main() and
 # tests/test_weights.py derive from
-ELECTION_CALLS = ("election.submit_solution",)
+ELECTION_CALLS = ("election.submit_solution", "election.submit_unsigned")
 
 
 # election.submit_solution needs a runtime sitting INSIDE the signed
@@ -849,14 +864,20 @@ ELECTION_CALLS = ("election.submit_solution",)
 def election_scenarios():
     from cess_tpu.chain import election as el
 
+    from cess_tpu.crypto import ed25519
+
     era = 30
     rt = Runtime(RuntimeConfig(era_blocks=era))
+    keys = {}
     for i in range(4):
         v = f"v{i}"
         rt.fund(v, 10_000_000 * D)
         rt.apply_extrinsic(v, "staking.bond", (4_000_000 + i) * D)
         rt.apply_extrinsic(v, "staking.validate")
-    rt.run_to_block(era - el.SIGNED_PHASE_BLOCKS + 1)
+        keys[v] = ed25519.SigningKey.generate(b"ew-sess:" + v.encode())
+        rt.system.set_session_key(v, keys[v].public)
+    rt.run_to_block(era - el.SIGNED_PHASE_BLOCKS
+                    - el.UNSIGNED_PHASE_BLOCKS + 1)
     assert rt.election.in_signed_phase()
     counter = {"n": 0}
 
@@ -871,7 +892,31 @@ def election_scenarios():
         score = el.score_of(sol, stakes, rt.credit.credits())
         return solver, ("election.submit_solution", sol, score)
 
-    return rt, {"election.submit_solution": submit_solution}
+    # the unsigned window needs its OWN runtime further into the era
+    rt2 = Runtime(RuntimeConfig(era_blocks=era))
+    keys2 = {}
+    for i in range(4):
+        v = f"v{i}"
+        rt2.fund(v, 10_000_000 * D)
+        rt2.apply_extrinsic(v, "staking.bond", (4_000_000 + i) * D)
+        rt2.apply_extrinsic(v, "staking.validate")
+        keys2[v] = ed25519.SigningKey.generate(b"ew2-sess:" + v.encode())
+        rt2.system.set_session_key(v, keys2[v].public)
+    rt2.run_to_block(era - el.UNSIGNED_PHASE_BLOCKS + 1)
+    assert rt2.election.in_unsigned_phase()
+
+    def submit_unsigned(_rt):
+        rt2.state.delete("election", "best_unsigned")
+        sol = ("v3", "v2", "v1")
+        stakes = {v: rt2.staking.bonded(v)
+                  for v in rt2.staking.validators()}
+        score = el.score_of(sol, stakes, rt2.credit.credits())
+        sig = keys2["v1"].sign(
+            rt2.election.unsigned_payload(sol, score, "v1"))
+        return "v1", ("election.submit_unsigned", sol, score, sig)
+
+    return {"election.submit_solution": (rt, submit_solution),
+            "election.submit_unsigned": (rt2, submit_unsigned)}
 
 
 # heavyweight setups: fewer reps keeps the full run under ~2 min
@@ -903,8 +948,7 @@ def measure(reps: int) -> dict[str, float]:
     rt = base_rt()
     for call, setup in scenarios().items():
         run(rt, call, setup, min(reps, SLOW_REPS.get(call, reps)))
-    ert, extra = election_scenarios()
-    for call, setup in extra.items():
+    for call, (ert, setup) in election_scenarios().items():
         run(ert, call, setup, min(reps, 20))
     return out
 
