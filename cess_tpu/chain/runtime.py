@@ -457,6 +457,45 @@ class Runtime:
                         error, gas_used, contract, log_start, log_count))
         self.state.put("ethereum", "count", block, idx + 1)
 
+    # receipts/logs retention: the eth view keeps this many recent
+    # blocks in STATE (real chains serve older receipts from block
+    # archives, not state — the repo's block store retains bodies, so
+    # anything older is recomputable by replay). ~6.8 h at 6 s slots.
+    ETH_HISTORY_BLOCKS = 4096
+    # backlog catch-up: a chain upgrading onto this code may carry
+    # arbitrarily many pre-pruner blocks; the cursor drains them a few
+    # per block instead of only ever pruning block N - WINDOW
+    # (review-caught), staying O(small) per block
+    ETH_PRUNE_BATCH = 8
+
+    def _prune_eth_history(self) -> None:
+        target = self.state.block - self.ETH_HISTORY_BLOCKS
+        if target < 0:
+            return
+        cursor = self.state.get("ethereum", "pruned_to", default=0)
+        done = 0
+        while cursor <= target and done < self.ETH_PRUNE_BATCH:
+            self._prune_eth_block(cursor)
+            cursor += 1
+            done += 1
+        if done:
+            self.state.put("ethereum", "pruned_to", cursor)
+
+    def _prune_eth_block(self, stale: int) -> None:
+        count = self.state.get("ethereum", "count", stale, default=0)
+        for idx in range(count):
+            rc = self.state.get("ethereum", "receipt", stale, idx)
+            if rc is not None:
+                self.state.delete("ethereum", "txloc", rc[0])
+            self.state.delete("ethereum", "receipt", stale, idx)
+        if count:
+            self.state.delete("ethereum", "count", stale)
+        nlogs = self.state.get("evm", "log_seq", stale, default=0)
+        for seq in range(nlogs):
+            self.state.delete("evm", "logs", stale, seq)
+        if nlogs:
+            self.state.delete("evm", "log_seq", stale)
+
     # -- block execution ---------------------------------------------------------
     def _update_randomness(self) -> None:
         prev = self.state.get("system", "randomness", default=b"genesis")
@@ -490,6 +529,7 @@ class Runtime:
             self._update_randomness()
         self.audit.on_initialize()
         self.evm.on_initialize()      # base-fee market roll
+        self._prune_eth_history()
         dead = self.storage_handler.on_initialize()
         self.file_bank.on_initialize(dead)
         self.credit.on_initialize()

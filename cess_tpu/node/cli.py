@@ -44,7 +44,7 @@ def main(argv=None) -> int:
                     choices=["run", "build-spec", "key", "sign",
                              "verify", "export-blocks", "import-blocks",
                              "revert", "check-block", "vanity",
-                             "benchmark"])
+                             "benchmark", "try-runtime"])
     ap.add_argument("--dev", action="store_true",
                     help="single-authority dev chain")
     ap.add_argument("--chain", default="dev",
@@ -88,6 +88,9 @@ def main(argv=None) -> int:
                          "(vanity)")
     ap.add_argument("--reps", type=int, default=20,
                     help="dispatches per benchmark sample")
+    ap.add_argument("--telemetry", default="",
+                    help="stream per-block telemetry JSON lines to "
+                         "this host:port endpoint")
     args = ap.parse_args(argv)
 
     def unhex(s: str) -> bytes:
@@ -124,9 +127,17 @@ def main(argv=None) -> int:
             print("--pattern longer than 6 hex digits would grind for "
                   "hours; refusing", file=sys.stderr)
             return 1
+        base = args.suri
+        if base == "dev-seed":
+            # the shared dev default would hand every operator the SAME
+            # deterministic "vanity" key; mix fresh entropy unless the
+            # caller pinned a suri deliberately (review-caught)
+            import secrets
+
+            base = "vanity-" + secrets.token_hex(16)
         i = 0
         while True:
-            seed = f"{args.suri}/{i}".encode()
+            seed = f"{base}/{i}".encode()
             key = ed25519.SigningKey.generate(seed)
             if key.public.hex().startswith(want):
                 print(json.dumps({"public": "0x" + key.public.hex(),
@@ -179,6 +190,15 @@ def main(argv=None) -> int:
             return 1
         return _block_tool(args, spec)
 
+    if args.subcommand == "try-runtime":
+        # the try-runtime role (ref node/src/cli.rs:23-70): dry-run the
+        # RUNNING code's pending migrations against a real persisted
+        # chain's state — report what would change, commit nothing
+        if not args.base_path:
+            print("--base-path required", file=sys.stderr)
+            return 1
+        return _try_runtime(args, spec)
+
     if args.port:
         return _run_tcp_node(args, spec)
 
@@ -189,6 +209,10 @@ def main(argv=None) -> int:
                              if args.base_path else None))
              for v in spec.validators]
     net = Network(nodes)
+    if args.telemetry:
+        from .metrics import TelemetryStream
+
+        nodes[0].offchain_agents.append(TelemetryStream(args.telemetry))
     rpc = None
     import threading
 
@@ -222,6 +246,69 @@ def main(argv=None) -> int:
     return 0
 
 
+def _data_dir(args, spec) -> "str | None":
+    """Locate the persisted node data dir under --base-path: an
+    existing node-* dir WITH a block log, or the base path itself if
+    it is one — never a directory that would make Node() silently
+    fabricate a fresh chain (shared by _block_tool and _try_runtime;
+    review-caught: try-runtime's own weaker scan could pick an
+    unrelated subdir and report against a fabricated genesis)."""
+    import os
+
+    from . import store as _store
+
+    candidates = sorted(
+        d for d in (os.listdir(args.base_path)
+                    if os.path.isdir(args.base_path) else [])
+        if d.startswith("node-")
+        and os.path.exists(os.path.join(args.base_path, d,
+                                        _store.BLOCKS_FILE)))
+    if candidates:
+        preferred = f"node-{spec.validators[0].account}"
+        base = os.path.join(args.base_path,
+                            preferred if preferred in candidates
+                            else candidates[0])
+        if len(candidates) > 1:
+            print(f"note: multiple node dirs {candidates}, using "
+                  f"{os.path.basename(base)}", file=sys.stderr)
+        return base
+    if os.path.exists(os.path.join(args.base_path, _store.BLOCKS_FILE)):
+        return args.base_path
+    return None
+
+
+def _try_runtime(args, spec) -> int:
+    from ..chain import migrations
+
+    base = _data_dir(args, spec)
+    if base is None:
+        print(f"no node data under {args.base_path}", file=sys.stderr)
+        return 1
+    node = Node(spec, "try-runtime", {}, base_path=base)
+    state = node.runtime.state
+    root_before = state.state_root()
+    before = migrations.spec_version(state)
+    versions_before = {pallet: migrations.storage_version(state, pallet)
+                       for pallet in migrations.current_versions()}
+    state.begin_tx()
+    try:
+        applied = migrations.run_pending(state)
+        after = migrations.spec_version(state)
+    finally:
+        state.rollback_tx()          # dry run: NOTHING commits
+    ok = state.state_root() == root_before
+    print(json.dumps({
+        "base_path": base,
+        "head": node.head().number,
+        "spec_version": {"on_chain": before, "code": after},
+        "storage_versions": versions_before,
+        "pending_migrations": applied,
+        "would_change_state": bool(applied),
+        "rollback_clean": ok,
+    }, indent=2))
+    return 0 if ok else 1
+
+
 def _run_tcp_node(args, spec) -> int:
     """Production-shaped deployment: ONE node per OS process, gossiping
     over TCP (the reference's model; node/src/service.rs). Peers are
@@ -239,6 +326,10 @@ def _run_tcp_node(args, spec) -> int:
     name = args.validator or f"full-{args.port}"
     base = os.path.join(args.base_path, f"node-{name}")         if args.base_path else None
     node = Node(spec, name, keystore, base_path=base)
+    if args.telemetry:
+        from .metrics import TelemetryStream
+
+        node.offchain_agents.append(TelemetryStream(args.telemetry))
     peers = [int(p) for p in args.peers.split(",") if p.strip()]
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
@@ -281,31 +372,14 @@ def _block_tool(args, spec) -> int:
 
     from . import store as _store
 
-    # locate the node data dir: an existing node-* dir with a block
-    # log, the base path itself if it IS one, or (only for
-    # import-blocks, which creates data) the canonical layout — never
-    # silently fabricate an empty chain for read-only tools
-    candidates = sorted(
-        d for d in (os.listdir(args.base_path)
-                    if os.path.isdir(args.base_path) else [])
-        if d.startswith("node-")
-        and os.path.exists(os.path.join(args.base_path, d,
-                                        _store.BLOCKS_FILE)))
-    if candidates:
-        preferred = f"node-{spec.validators[0].account}"
-        base = os.path.join(args.base_path,
-                            preferred if preferred in candidates
-                            else candidates[0])
-        if len(candidates) > 1:
-            print(f"note: multiple node dirs {candidates}, using "
-                  f"{os.path.basename(base)}", file=sys.stderr)
-    elif os.path.exists(os.path.join(args.base_path, _store.BLOCKS_FILE)):
-        base = args.base_path
-    elif args.subcommand == "import-blocks":
+    # locate the node data dir (shared helper; import-blocks alone may
+    # create the canonical layout — it writes data by design)
+    base = _data_dir(args, spec)
+    if base is None and args.subcommand == "import-blocks":
         base = os.path.join(args.base_path,
                             f"node-{spec.validators[0].account}")
         os.makedirs(base, exist_ok=True)
-    else:
+    elif base is None:
         print(f"no node data under {args.base_path}", file=sys.stderr)
         return 1
     node = Node(spec, "tool", {}, base_path=base)

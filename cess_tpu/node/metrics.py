@@ -63,6 +63,113 @@ def render_metrics(node) -> str:
     return "\n".join(lines) + "\n"
 
 
+class TelemetryStream:
+    """Push telemetry to an external endpoint (the reference's
+    telemetry worker streaming to telemetry.polkadot.io-style
+    collectors, /root/reference/node/src/service.rs:227-234): one JSON
+    line per imported block over a persistent TCP connection to
+    ``host:port``.
+
+    Connection failures NEVER affect the node: on_block only enqueues
+    into a bounded queue; ALL network IO (blocking connects to
+    firewalled hosts included — a 1 s SYN timeout on the import thread
+    would eat the slot budget, review-caught) runs on a dedicated
+    sender thread, and a full queue drops the oldest records."""
+
+    RECONNECT_COOLDOWN = 5.0
+    QUEUE_CAP = 256
+
+    def __init__(self, endpoint: str):
+        import queue
+        import threading
+
+        host, _, port = endpoint.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self._q: "queue.Queue[dict | None]" = queue.Queue(self.QUEUE_CAP)
+        self._sock = None
+        self._next_try = 0.0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def on_block(self, node) -> None:
+        head = node.head()
+        rec = {
+            "ts": round(time.time(), 3),
+            "node": node.name,
+            "chain": node.spec.chain_id,
+            "best": head.number,
+            "best_hash": head.hash().hex(),
+            "finalized": node.finalized,
+            "txcount": len(node.tx_pool),
+            "authorities": len(node.authorities),
+            "version": _spec_version(node),
+        }
+        import queue
+
+        try:
+            self._q.put_nowait(rec)
+        except queue.Full:
+            try:                       # drop the OLDEST, keep current
+                self._q.get_nowait()
+                self._q.put_nowait(rec)
+            except queue.Empty:
+                pass
+
+    # -- sender thread -------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            rec = self._q.get()
+            if rec is None:
+                return
+            sock = self._connect()
+            if sock is None:
+                continue               # endpoint down: record dropped
+            try:
+                sock.sendall((json.dumps(rec) + "\n").encode())
+            except OSError:
+                self._drop_conn()
+
+    def _connect(self):
+        import socket
+
+        now = time.time()
+        if self._sock is None and now >= self._next_try:
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=1.0)
+            except OSError:
+                self._next_try = now + self.RECONNECT_COOLDOWN
+        return self._sock
+
+    def _drop_conn(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._next_try = time.time() + self.RECONNECT_COOLDOWN
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Flush queued records (best effort) and stop the sender."""
+        import queue
+
+        try:
+            self._q.put(None, timeout=timeout)
+        except queue.Full:
+            pass
+        self._worker.join(timeout=timeout)
+        self._drop_conn()
+        self._next_try = 0.0
+
+
+def _spec_version(node) -> int:
+    from ..chain import migrations
+
+    return migrations.spec_version(node.runtime.state)
+
+
 class BlockLogger:
     """Offchain-agent-shaped structured logger: one JSON line per
     imported/authored block (height, hash, author, events, pool)."""

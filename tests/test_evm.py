@@ -844,3 +844,44 @@ def test_delegatecall_to_precompile_moves_no_value(rt):
     assert int.from_bytes(out, "big") == 50
     assert rt.evm.balance_of(dlg) == 50
     assert rt.evm.balance_of((4).to_bytes(20, "big")) == 0
+
+
+def test_eth_history_pruned_incrementally():
+    """Receipts/logs/txlocs expire out of STATE after the retention
+    window, one block's worth per block (bounded state growth; older
+    data is recomputable from block archives by replay)."""
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.crypto import ed25519
+
+    rt = Runtime(RuntimeConfig(era_blocks=10 ** 6))
+    rt.ETH_HISTORY_BLOCKS = 5          # small window for the test
+    rt.fund("dev", 1_000 * D)
+    key = ed25519.SigningKey.generate(b"dev-prune")
+    rt.init_block()
+    addr = rt.apply_extrinsic("dev", "evm.deploy", TOKEN_INIT)
+    hashes = []
+    for i in range(8):
+        rt.init_block()
+        xt = sign_extrinsic(key, rt.genesis_hash(), "dev",
+                            rt.system.nonce("dev"), "evm.call",
+                            (addr, calldata(1, eth_address("bob"), 1)),
+                            ())
+        import hashlib as _hl
+
+        from cess_tpu import codec as _codec
+
+        rt.apply_in_block(xt)
+        hashes.append((_hl.sha256(_codec.encode(xt)).digest(),
+                       rt.state.block))
+    head = rt.state.block
+    for h, blk in hashes:
+        loc = rt.state.get("ethereum", "txloc", h)
+        nlogs = rt.state.get("evm", "log_seq", blk, default=0)
+        if blk <= head - rt.ETH_HISTORY_BLOCKS:
+            assert loc is None, f"block {blk} receipt not pruned"
+            assert nlogs == 0, f"block {blk} logs not pruned"
+            assert rt.state.get("ethereum", "count", blk, default=0) == 0
+        else:
+            assert loc == (blk, 0)
+            assert rt.state.get("ethereum", "receipt", blk, 0) is not None
+            assert nlogs == 1

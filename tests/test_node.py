@@ -341,3 +341,95 @@ def test_cli_vanity_and_benchmark(capsys):
     rep = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rep["weight_unit_us"] > 0
     assert rep["transfers_per_6s_block"] > 100
+
+
+def test_cli_try_runtime_dry_runs_migrations(tmp_path, capsys):
+    """try-runtime analog: load a persisted chain born at an OLD spec
+    version, report the pending migrations, commit nothing."""
+    import json as _json
+
+    from cess_tpu.chain import migrations
+    from cess_tpu.node import cli
+    from cess_tpu.node.chain_spec import dev_spec, spec_to_json
+    from cess_tpu.node.network import Network, Node
+
+    import dataclasses as _dc
+
+    spec = _dc.replace(dev_spec(), genesis_spec_version=109)
+    base = tmp_path / "node-alice"
+    node = Node(spec, "alice", {"alice": spec.session_key("alice")},
+                base_path=str(base))
+    Network([node]).run_slots(3)
+    if node.store is not None:
+        node.store.close()
+    spec_file = tmp_path / "spec.json"
+    spec_file.write_text(_json.dumps(spec_to_json(spec)))
+    root_before = node.runtime.state.state_root()
+
+    rc = cli.main(["try-runtime", "--chain", str(spec_file),
+                   "--base-path", str(tmp_path)])
+    assert rc == 0
+    rep = _json.loads(capsys.readouterr().out)
+    assert rep["spec_version"]["on_chain"] == 109
+    assert rep["spec_version"]["code"] == migrations.SPEC_VERSION
+    assert rep["pending_migrations"], "upgradable chain shows migrations"
+    assert rep["would_change_state"] and rep["rollback_clean"]
+
+    # the persisted chain itself is untouched: reload and compare roots
+    node2 = Node(spec, "alice2", {}, base_path=str(base))
+    assert node2.runtime.state.state_root() == root_before
+
+
+def test_telemetry_stream_endpoint():
+    """Telemetry streaming (ref service.rs:227-234): per-block JSON
+    lines arrive at the collector endpoint; a dead endpoint never
+    disturbs block production."""
+    import json as _json
+    import socket
+    import threading
+
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.metrics import TelemetryStream
+    from cess_tpu.node.network import Network, Node
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    received = []
+
+    def collector():
+        conn, _ = srv.accept()
+        conn.settimeout(5)
+        buf = b""
+        try:
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+        except OSError:
+            pass
+        received.extend(_json.loads(line)
+                        for line in buf.decode().splitlines() if line)
+
+    t = threading.Thread(target=collector, daemon=True)
+    t.start()
+    spec = dev_spec()
+    node = Node(spec, "telem", {"alice": spec.session_key("alice")})
+    tele = TelemetryStream(f"127.0.0.1:{port}")
+    node.offchain_agents.append(tele)
+    Network([node]).run_slots(3)
+    tele.close()
+    srv.close()
+    t.join(timeout=5)
+    assert [r["best"] for r in received] == [1, 2, 3]
+    assert all(r["chain"] == "dev" and r["node"] == "telem"
+               and "finalized" in r and "version" in r
+               for r in received)
+
+    # a dead endpoint: no exception, blocks keep flowing
+    dead = Node(spec, "t2", {"alice": spec.session_key("alice")})
+    dead.offchain_agents.append(TelemetryStream("127.0.0.1:1"))
+    Network([dead]).run_slots(2)
+    assert dead.head().number == 2
