@@ -172,8 +172,11 @@ def bench_cpu_baseline(seg_size, reps) -> tuple[float, bool]:
         t0 = time.perf_counter()
         codec.encode_parity(data)
         times.append(time.perf_counter() - t0)
-    # median: robust to transient host contention in either direction
-    dt = sorted(times)[len(times) // 2]
+    # BEST time: host contention can only slow the baseline down, and
+    # crediting it with its fastest observed run keeps the reported
+    # speedup conservative (median swung the ratio 90x-190x between
+    # loaded and idle runs)
+    dt = min(times)
     return seg_size / 2**30 / dt, native
 
 
@@ -257,13 +260,22 @@ def bench_podr2(jnp, jax, resident, frag_size, total, verify_chunk):
     ids0 = jnp.arange(resident, dtype=jnp.uint32)
     frags, salt = tag_step(frags, ids0, jnp.uint8(0))
     _ = np.asarray(salt)
-    t0 = time.perf_counter()
-    for it in range(iters):
-        ids = jnp.arange(it * resident, (it + 1) * resident,
-                         dtype=jnp.uint32)
-        frags, salt = tag_step(frags, ids, salt.astype(jnp.uint8))
-    _ = np.asarray(salt)
-    tag_t = time.perf_counter() - t0
+    # 3 windows, best-window rate: a single multi-second device-tunnel
+    # stall mid-run otherwise poisons the whole measurement (observed
+    # 5x swings between back-to-back runs; same discipline as repair)
+    win = max(1, iters // 3)
+    tag_rates = []
+    it = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(win):
+            ids = jnp.arange(it * resident, (it + 1) * resident,
+                             dtype=jnp.uint32)
+            frags, salt = tag_step(frags, ids, salt.astype(jnp.uint8))
+            it += 1
+        _ = np.asarray(salt)
+        tag_rates.append(win * resident / (time.perf_counter() - t0))
+    tag_t = (3 * win * resident) / max(tag_rates)
 
     # -- challenge-verify ---------------------------------------------------
     idx, nu = podr2.gen_challenge(b"bench-round", blocks)
@@ -274,23 +286,31 @@ def bench_podr2(jnp, jax, resident, frag_size, total, verify_chunk):
         return jnp.sum(ok.astype(jnp.int32))
 
     mu = jnp.zeros((verify_chunk, params.sectors), dtype=jnp.uint32)
-    sigma = jnp.zeros((verify_chunk, 2), dtype=jnp.uint32)
+    sigma = jnp.zeros((verify_chunk, podr2.LIMBS), dtype=jnp.uint32)
     ids2 = jnp.zeros((verify_chunk, 2), dtype=jnp.uint32)
     _ = np.asarray(verify_step(ids2, mu, sigma))  # compile
     chunks = max(1, total // verify_chunk)
+    vwin = max(1, chunks // 3)
+    ver_rates = []
     acc = 0
-    t0 = time.perf_counter()
-    for c in range(chunks):
-        ids2 = jnp.stack([
-            jnp.arange(c * verify_chunk, (c + 1) * verify_chunk,
-                       dtype=jnp.uint32),
-            jnp.full((verify_chunk,), acc & 0xFF, dtype=jnp.uint32)], axis=1)
-        acc = int(np.asarray(verify_step(ids2, mu, sigma)))
-    verify_t = time.perf_counter() - t0
+    c = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(vwin):
+            ids2 = jnp.stack([
+                jnp.arange(c * verify_chunk, (c + 1) * verify_chunk,
+                           dtype=jnp.uint32),
+                jnp.full((verify_chunk,), acc & 0xFF,
+                         dtype=jnp.uint32)], axis=1)
+            acc = int(np.asarray(verify_step(ids2, mu, sigma)))
+            c += 1
+        ver_rates.append(vwin * verify_chunk
+                         / (time.perf_counter() - t0))
+    verify_t = (3 * vwin * verify_chunk) / max(ver_rates)
 
     # combined pipeline rate: harmonic combination of per-stage rates
-    return 1.0 / (tag_t / (iters * resident)
-                  + verify_t / (chunks * verify_chunk))
+    return 1.0 / (tag_t / (3 * win * resident)
+                  + verify_t / (3 * vwin * verify_chunk))
 
 
 def main() -> None:
@@ -325,7 +345,7 @@ def main() -> None:
         # resident cap: pack_bytes materializes ~4x the fragment batch
         # as u32 temps; 128 x 8 MiB keeps peak HBM ~9 GiB < 15.75 GiB
         resident, total, vchunk = 128, 100_000, 4096
-        repair_reps, cpu_reps = 200, 3
+        repair_reps, cpu_reps = 200, 7
 
     encode_gibps = None
     if "encode" in which or "speedup" in which:

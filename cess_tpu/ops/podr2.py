@@ -174,9 +174,13 @@ def tag_from_elems(alpha, f, m):
 
     m is base-field, alpha [s, limbs] is F_p^limbs: the product is
     componentwise, so each limb is an independent base-field MAC.
-    m < 2^16 by the pack_bytes width-2 embedding, so the data-side
-    mulmod_u16 fast path applies (the MAC multiply is the tag-gen
-    hot loop: 4M elements x limbs per 8 MiB fragment)."""
+    m < 2^16 by the pack_bytes width-2 embedding, and sectors <= 256,
+    so the deferred-reduction dot applies (the MAC is the tag-gen hot
+    loop: 4M elements x limbs per 8 MiB fragment; see
+    pf.dot_u16_deferred)."""
+    if m.shape[-1] <= 256:
+        return pf.addmod(f, pf.dot_u16_deferred(
+            m[..., None], alpha[None, :, :], axis=-2))
     return pf.addmod(f, pf.summod(
         pf.mulmod_u16(m[..., None], alpha[None, :, :]), axis=-2))
 
@@ -196,8 +200,23 @@ def tag_fragment(key: Podr2Key, fragment_id, fragment) -> jax.Array:
 
 
 def tag_fragments(key: Podr2Key, fragment_ids, fragments) -> jax.Array:
-    """Batched tag-gen: ids [F], fragments [F, fragment_bytes] -> [F, blocks, 2]."""
-    return jax.vmap(lambda i, d: tag_fragment(key, i, d))(fragment_ids, fragments)
+    """Batched tag-gen: ids [F], fragments [F, fragment_bytes] ->
+    [F, blocks, limbs]. Routes through the fused Pallas kernel
+    (ops/podr2_pallas.py) when the shape envelope allows — identical
+    results, one VMEM pass instead of materialised pack/MAC stages."""
+    from . import podr2_pallas
+
+    fragments = jnp.asarray(fragments)
+    sectors = key.alpha.shape[0]
+    blocks = fragments.shape[-1] // (sectors * pf.BYTES_PER_ELEM)
+    if podr2_pallas.supported(sectors, blocks):
+        prf = jax.vmap(
+            lambda i: prf_elems(key.prf_key, i, blocks,
+                                key.limbs))(fragment_ids)
+        return podr2_pallas.tag_fragments_fused(key.alpha, prf,
+                                                fragments)
+    return jax.vmap(lambda i, d: tag_fragment(key, i, d))(fragment_ids,
+                                                          fragments)
 
 
 def gen_challenge(seed_bytes: bytes | int, num_blocks: int,

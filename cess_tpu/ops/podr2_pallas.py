@@ -1,0 +1,132 @@
+"""Pallas-fused PoDR2 tag generation (TPU).
+
+The pure-XLA tag path (podr2.tag_from_elems) materialises the packed
+field elements [F, blocks, sectors] u32 (2x the fragment bytes) plus
+the partial-product reduction traffic in HBM. This kernel fuses the
+whole per-tile chain — u16 view -> 8-bit data limbs x 16-bit alpha
+limbs -> four deferred-reduction partial sums -> modular fold -> PRF
+add — inside VMEM, so HBM traffic is one pass over the u16 fragment
+view plus the (tiny) PRF values and tag outputs.
+
+Layout contract:
+- m16  [F, blocks, sectors] uint16: the little-endian u16 view of the
+  fragment bytes (a bitcast, same embedding as pf.pack_bytes width 2);
+- alpha limb planes [limbs, 2, sectors] uint32: (a & 0xFFFF, a >> 16)
+  per MAC limb;
+- prf  [F, limbs, blocks] uint32 (limb-major so the block axis is the
+  128-lane axis);
+- out  [F, limbs, blocks] uint32 tags, transposed by the caller to the
+  protocol's [F, blocks, limbs].
+
+The grid walks (fragment, block-tile); each step MACs a
+[BT, sectors] tile with all partial products < 2^24, so plain uint32
+accumulation over sectors <= 256 is exact (see pf.dot_u16_deferred,
+whose math this kernel inlines). Interpret mode runs the identical
+kernel on the CPU test mesh; tests pin it byte-equal to the jnp path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import pfield as pf
+
+DEFAULT_BLOCK_TILE = 256
+
+
+def _target_platform() -> str:
+    """The platform the jitted call will actually run on: honors a
+    jax.default_device pin (AuditBackend('cpu') on a TPU host pins the
+    CPU device while jax.default_backend() still says 'tpu' —
+    Mosaic-lowering the kernel there would fail; review-caught).
+    Interpret mode runs everywhere else."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return dev.platform
+    return jax.default_backend()
+
+
+def _kernel(limbs: int):
+    def kernel(a_ref, f_ref, m_ref, out_ref):
+        # Mosaic has no unsigned reductions: accumulate in int32 —
+        # every partial product is < 2^24 and the 256-term sum < 2^32,
+        # so int32 wraparound is the BIT-EXACT uint32 sum; a bitcast
+        # recovers it before the modular fold
+        m = m_ref[0].astype(jnp.int32)             # [bt, s]
+        mlo = m & 0xFF
+        mhi = m >> 8
+
+        def usum(x):
+            return jax.lax.bitcast_convert_type(
+                jnp.sum(x, axis=1, dtype=jnp.int32), jnp.uint32)
+
+        for limb in range(limbs):
+            a0 = a_ref[limb, 0][None, :]           # [1, s] int32
+            a1 = a_ref[limb, 1][None, :]
+            s00 = usum(mlo * a0)
+            s10 = usum(mhi * a0)
+            s01 = usum(mlo * a1)
+            s11 = usum(mhi * a1)
+            acc = pf.addmod(
+                pf.addmod(pf.to_field(s00),
+                          pf.rotk(pf.to_field(s10), 8)),
+                pf.addmod(pf.rotk(pf.to_field(s01), 16),
+                          pf.rotk(pf.to_field(s11), 24)))
+            out_ref[0, limb] = pf.addmod(f_ref[0, limb], acc)
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _tags_3d(alpha_planes: jax.Array, prf: jax.Array, m16: jax.Array,
+             limbs: int, sectors: int, block_tile: int) -> jax.Array:
+    """[F, blocks, s] u16 + [F, limbs, blocks] PRF -> [F, limbs, blocks]."""
+    fcount, blocks, _ = m16.shape
+    interpret = _target_platform() != "tpu"
+    return pl.pallas_call(
+        _kernel(limbs),
+        grid=(fcount, blocks // block_tile),
+        in_specs=[
+            pl.BlockSpec((limbs, 2, sectors), lambda i, t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, limbs, block_tile), lambda i, t: (i, 0, t),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_tile, sectors), lambda i, t: (i, t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, limbs, block_tile),
+                               lambda i, t: (i, 0, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fcount, limbs, blocks),
+                                       jnp.uint32),
+        interpret=interpret,
+    )(alpha_planes, prf, m16)
+
+
+def supported(sectors: int, blocks: int) -> bool:
+    """The fused path's shape envelope; callers fall back to the jnp
+    path outside it (protocol results are identical either way)."""
+    return (sectors <= 256 and sectors % 128 == 0
+            and blocks % min(blocks, DEFAULT_BLOCK_TILE) == 0)
+
+
+def tag_fragments_fused(alpha: jax.Array, prf: jax.Array,
+                        fragments: jax.Array) -> jax.Array:
+    """fragments [F, bytes] uint8, prf [F, blocks, limbs] ->
+    tags [F, blocks, limbs] (the tag_from_elems contract, fused)."""
+    fcount, nbytes = fragments.shape
+    sectors, limbs = alpha.shape
+    blocks = nbytes // (sectors * pf.BYTES_PER_ELEM)
+    m16 = jax.lax.bitcast_convert_type(
+        fragments.reshape(fcount, blocks * sectors, 2),
+        jnp.uint16).reshape(fcount, blocks, sectors)
+    planes = jnp.stack([alpha.T & 0xFFFF, alpha.T >> 16],
+                       axis=1).astype(jnp.int32)    # [limbs, 2, s]
+    tile = min(blocks, DEFAULT_BLOCK_TILE)
+    out = _tags_3d(planes, jnp.moveaxis(prf, -1, 1), m16,
+                   limbs, sectors, tile)
+    return jnp.moveaxis(out, 1, -1)                 # [F, blocks, limbs]

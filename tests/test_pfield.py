@@ -105,3 +105,45 @@ def test_mulmod_u16_matches_bigint():
     np.testing.assert_array_equal(got.astype(object), want)
     # and agrees with the generic mulmod
     np.testing.assert_array_equal(got, pf.mulmod(aa.ravel(), bb.ravel()))
+
+
+def test_dot_u16_deferred_matches_bigint():
+    """Deferred-reduction dot (the tag-gen hot loop): exact vs bigint
+    at the boundary shapes — full 256-length axis of maximal values."""
+    import numpy as np
+
+    from cess_tpu.ops import pfield as pf
+
+    rng = np.random.default_rng(9)
+    for s in (1, 7, 256):
+        m = rng.integers(0, 1 << 16, (5, s), dtype=np.uint32)
+        b = rng.integers(0, pf.P, (s,), dtype=np.uint32)
+        got = pf.dot_u16_deferred(m, b[None, :], axis=1)
+        want = np.array([sum(int(x) * int(y) for x, y in zip(row, b))
+                         % pf.P for row in m], dtype=object)
+        np.testing.assert_array_equal(got.astype(object), want)
+    # worst case: every operand maximal on the full 256 axis
+    m = np.full((2, 256), (1 << 16) - 1, dtype=np.uint32)
+    b = np.full((256,), pf.P - 1, dtype=np.uint32)
+    got = pf.dot_u16_deferred(m, b[None, :], axis=1)
+    want = (256 * ((1 << 16) - 1) * (pf.P - 1)) % pf.P
+    assert all(int(v) == want for v in got)
+
+
+def test_pack_bytes_device_bitcast_matches_numpy_oracle():
+    """The device bitcast pack and the numpy shift-or oracle are the
+    SAME little-endian embedding (protocol invariant: tags derived on
+    either path must agree byte-exactly)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cess_tpu.ops import pfield as pf
+
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (4, 1024), dtype=np.uint8)
+    dev = np.asarray(pf.pack_bytes(jnp.asarray(data)))
+    host = pf.pack_bytes(data)
+    np.testing.assert_array_equal(dev, host)
+    # explicit endianness pin: bytes [lo, hi] -> lo | hi<<8
+    two = np.array([[0x34, 0x12]], dtype=np.uint8)
+    assert int(np.asarray(pf.pack_bytes(jnp.asarray(two)))[0]) == 0x1234
