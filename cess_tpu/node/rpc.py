@@ -401,8 +401,40 @@ class RpcServer:
         # boundary module; ref node/src/rpc.rs:229-328) ------------------
         if method == "web3_clientVersion":
             return "cess-tpu/evm-boundary"
+        if method == "web3_sha3":
+            # the EVM boundary's SHA3 family (documented sha3_256
+            # deviation, chain/evm_interp.py)
+            from ..chain.evm_interp import sha3 as _sha3
+
+            try:
+                data = _decode(params[0]) if params else None
+                if not isinstance(data, bytes):
+                    raise ValueError("data must be 0x-prefixed hex")
+            except (ValueError, TypeError, IndexError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
+            return "0x" + _sha3(data).hex()
         if method == "net_version":
             return str(_eth_chain_id(node.spec))
+        if method == "eth_syncing":
+            return False            # replicas import synchronously here
+        if method == "eth_accounts":
+            return []               # keys never live in the node
+        if method == "eth_getBlockTransactionCountByNumber":
+            if not params:
+                raise RpcError(INVALID_PARAMS, "expected [number]")
+            try:
+                n = self._blocknum(params[0], node.head().number)
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
+            if not 0 <= n <= node.head().number:
+                return None
+            count = rt.state.get("ethereum", "count", n)
+            if count is None:
+                # receipts pruned out of state for old blocks — the
+                # retained block BODY is the correct source there
+                body = node.block_bodies.get(n)
+                count = len(body.extrinsics) if body is not None else 0
+            return hex(count)
         if method == "eth_chainId":
             return hex(_eth_chain_id(node.spec))
         if method == "eth_blockNumber":

@@ -885,3 +885,39 @@ def test_eth_history_pruned_incrementally():
             assert loc == (blk, 0)
             assert rt.state.get("ethereum", "receipt", blk, 0) is not None
             assert nlogs == 1
+
+
+def test_eth_misc_tooling_probes():
+    """The small eth-namespace probes wallets/tooling fire on connect:
+    syncing, accounts, web3_sha3, per-block tx counts."""
+    import hashlib
+
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "misc", {"alice": spec.session_key("alice")})
+    srv = RpcServer(node, port=0)
+    node.submit_extrinsic("alice", "evm.deploy", TOKEN_INIT)
+    node.try_author(1) and node.commit_proposal()
+    assert srv.handle("eth_syncing", []) is False
+    assert srv.handle("eth_accounts", []) == []
+    assert srv.handle("web3_sha3", ["0x" + b"abc".hex()]) \
+        == "0x" + hashlib.sha3_256(b"abc").hexdigest()
+    assert srv.handle("eth_getBlockTransactionCountByNumber",
+                      ["0x1"]) == "0x1"
+    assert srv.handle("eth_getBlockTransactionCountByNumber",
+                      ["0x99"]) is None
+    # malformed web3_sha3 input is INVALID_PARAMS, never a server error
+    import pytest as _pytest
+
+    from cess_tpu.node.rpc import RpcError
+    for bad in (["0xzz"], ["abc"], []):
+        with _pytest.raises(RpcError) as e:
+            srv.handle("web3_sha3", bad)
+        assert e.value.code == -32602
+    # a pruned-out old block falls back to the retained body's count
+    node.runtime.state.delete("ethereum", "count", 1)
+    assert srv.handle("eth_getBlockTransactionCountByNumber",
+                      ["0x1"]) == "0x1"
