@@ -431,9 +431,13 @@ class RpcServer:
             count = rt.state.get("ethereum", "count", n)
             if count is None:
                 # receipts pruned out of state for old blocks — the
-                # retained block BODY is the correct source there
+                # retained block BODY is the correct source there; a
+                # node without the body (warp-synced) answers null
+                # rather than fabricating "empty"
                 body = node.block_bodies.get(n)
-                count = len(body.extrinsics) if body is not None else 0
+                if body is None:
+                    return None
+                count = len(body.extrinsics)
             return hex(count)
         if method == "eth_chainId":
             return hex(_eth_chain_id(node.spec))

@@ -921,3 +921,7 @@ def test_eth_misc_tooling_probes():
     node.runtime.state.delete("ethereum", "count", 1)
     assert srv.handle("eth_getBlockTransactionCountByNumber",
                       ["0x1"]) == "0x1"
+    # no body either (warp-synced node): null, never a fabricated 0x0
+    node.block_bodies.pop(1)
+    assert srv.handle("eth_getBlockTransactionCountByNumber",
+                      ["0x1"]) is None

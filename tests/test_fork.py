@@ -209,3 +209,83 @@ def test_warp_sync_checkpoint():
     Network(other_nodes).run_slots(3)
     fresh3 = Node(spec, "f3", {})
     assert fresh3.warp_sync_from(other_nodes[0]) is False
+
+
+def test_reorg_rewinds_receipts():
+    """Round-5 receipt state obeys the undo log: a receipt recorded
+    only on the losing branch vanishes with the reorg and reappears
+    once the requeued tx re-executes on the winning chain."""
+    import hashlib
+
+    from cess_tpu import codec
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+
+    spec, nodes = make_nodes(4, chain_id="fork-rcpt")
+    net = Network(nodes)
+    net.run_slots(2)
+    part_a, part_b = Network(nodes[:1]), Network(nodes[1:])
+    node = nodes[0]
+    xt = sign_extrinsic(spec.account_key("alice"),
+                        node.runtime.genesis_hash(), "alice",
+                        node.runtime.system.nonce("alice"),
+                        "balances.transfer", ("bob", 3 * D), ())
+    txhash = hashlib.sha256(codec.encode(xt)).digest()
+    node.submit_signed(xt)
+    part_a.run_slots(2)
+    assert node.runtime.state.get("ethereum", "txloc", txhash) is not None
+    part_b.run_slots(4)
+    node.sync_from(nodes[1])           # reorg away the tx's branch
+    assert node.chain[-1].hash() == nodes[1].chain[-1].hash()
+    # the receipt rewound with its block
+    assert node.runtime.state.get("ethereum", "txloc", txhash) is None
+    merged = Network(nodes)
+    merged.run_slots(2)                # requeued tx re-executes
+    for n in nodes:
+        loc = n.runtime.state.get("ethereum", "txloc", txhash)
+        assert loc is not None
+        rc = n.runtime.state.get("ethereum", "receipt", *loc)
+        assert rc is not None and rc[3] == 1      # status ok
+    roots = {n.runtime.state.state_root() for n in nodes}
+    assert len(roots) == 1
+
+
+def test_reorg_rewinds_unsigned_election_queue():
+    """A queued unsigned election solution is dispatch-recorded state:
+    a reorg away from the branch that accepted it must rewind the
+    queue (otherwise a minority-branch solution could win the era on
+    the majority chain without ever being admitted there)."""
+    from cess_tpu.chain import election as el
+    from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis
+
+    era = 30
+    spec = ChainSpec(
+        name="t", chain_id="fork-unsig",
+        endowed=(("alice", 1_000_000_000 * D),),
+        validators=tuple(ValidatorGenesis(f"v{i}", (4_000_000 + i) * D)
+                         for i in range(4)),
+        era_blocks=era, epoch_blocks=era, sudo="alice")
+    nodes = [Node(spec, f"node{i}", {f"v{i}": spec.session_key(f"v{i}")})
+             for i in range(4)]
+    net = Network(nodes)
+    net.run_slots(era - el.UNSIGNED_PHASE_BLOCKS)   # into the window
+    node = nodes[0]
+    assert node.runtime.election.in_unsigned_phase()
+    part_a, part_b = Network(nodes[:1]), Network(nodes[1:])
+    sol = ("v3", "v2", "v1")
+    stakes = {v: node.runtime.staking.bonded(v)
+              for v in node.runtime.staking.validators()}
+    score = el.score_of(sol, stakes, node.runtime.credit.credits())
+    sig = spec.session_key("v0").sign(
+        node.runtime.election.unsigned_payload(sol, score, "v0"))
+    node.submit_extrinsic("v0", "election.submit_unsigned", sol, score,
+                          sig)
+    part_a.run_slots(1)      # minority branch admits the solution
+    assert node.runtime.state.get("election", "best_unsigned") \
+        is not None
+    part_b.run_slots(3)      # heavier branch, still inside the era
+    node.sync_from(nodes[1])
+    assert node.chain[-1].hash() == nodes[1].chain[-1].hash()
+    assert node.runtime.state.get("election", "best_unsigned") is None
+    roots = {node.runtime.state.state_root(),
+             nodes[1].runtime.state.state_root()}
+    assert len(roots) == 1
