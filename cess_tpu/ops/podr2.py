@@ -61,9 +61,11 @@ from . import pfield as pf
 SECTORS = 256                       # field elements per block
 BLOCK_BYTES = SECTORS * pf.BYTES_PER_ELEM   # 512
 # Default MAC limb count: F_p^LIMBS, soundness ~p^-LIMBS per verify.
-# MEASURED on the real v5e chip (r05, 128 x 8 MiB resident batches):
-# LIMBS=2 (soundness ~2^-62) tags at ~1926 frags/s, LIMBS=3 (~2^-93)
-# at ~1681 — the third limb costs ~13% of tag throughput (tag-gen is
+# MEASURED on the real v5e chip (r05, 128 x 8 MiB resident batches,
+# jnp path): LIMBS=2 (soundness ~2^-62) tags at ~1926 frags/s,
+# LIMBS=3 (~2^-93) at ~1681 — the third limb costs ~13% of tag
+# throughput, and per-limb cost scales the same through the fused
+# kernel (ops/podr2_pallas.py, ~6.4k frags/s at limbs=2 — tag-gen is
 # the dominant audit stage; verify evaluates the PRF only at the
 # challenged blocks and is width-insensitive). 2 stays the default:
 # at protocol caps (8000 miners x 14400 rounds/day) a 2^-62 forgery
@@ -209,7 +211,10 @@ def tag_fragments(key: Podr2Key, fragment_ids, fragments) -> jax.Array:
     fragments = jnp.asarray(fragments)
     sectors = key.alpha.shape[0]
     blocks = fragments.shape[-1] // (sectors * pf.BYTES_PER_ELEM)
-    if podr2_pallas.supported(sectors, blocks):
+    # a TRACED alpha (key passed as a jit argument) cannot feed the
+    # kernel's host-side weight precompute; the jnp path traces fine
+    alpha_concrete = not isinstance(key.alpha, jax.core.Tracer)
+    if alpha_concrete and podr2_pallas.supported(sectors, blocks):
         prf = jax.vmap(
             lambda i: prf_elems(key.prf_key, i, blocks,
                                 key.limbs))(fragment_ids)

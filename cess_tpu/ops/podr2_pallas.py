@@ -2,27 +2,37 @@
 
 The pure-XLA tag path (podr2.tag_from_elems) materialises the packed
 field elements [F, blocks, sectors] u32 (2x the fragment bytes) plus
-the partial-product reduction traffic in HBM. This kernel fuses the
-whole per-tile chain — u16 view -> 8-bit data limbs x 16-bit alpha
-limbs -> four deferred-reduction partial sums -> modular fold -> PRF
-add — inside VMEM, so HBM traffic is one pass over the u16 fragment
-view plus the (tiny) PRF values and tag outputs.
+the partial-product reduction traffic in HBM. This kernel reads the
+RAW fragment bytes once and produces tags — nothing else touches HBM.
+
+The trick that removes byte-unpacking entirely: the MAC is linear, so
+    sum_j m_j * alpha_j
+      = sum_j (b_{2j} + 256 b_{2j+1}) * alpha_j
+      = sum_i b_i * W_i          with  W_{2j}   = alpha_j
+                                       W_{2j+1} = 256 * alpha_j mod p
+— an INTERLEAVED field-weight vector over the natural byte lanes. W is
+split into 16-bit limbs (w0, w1) host-side; every in-kernel partial
+product b_i * w ( < 2^8 * 2^16 = 2^24 ) accumulates exactly in 32-bit
+lanes over <= 256-term chunks (256 * 255 * 65535 < 2^32), with one
+modular fold per chunk per output element. Measured on v5e (r05):
+~6.4k frags/s for 8 MiB fragments at limbs=2 — vs ~3.1k for a u16
+bitcast variant and ~1.9k for the jnp path — because the kernel's HBM
+traffic is exactly one pass over the u8 input.
+
+Mosaic constraints shaping this design: no unsigned reductions (sums
+run in int32 and bitcast back — bit-exact below 2^32), no in-kernel
+bitwidth-changing bitcasts, and strided u8 gathers ICE the compiler —
+the interleaved weights avoid all three.
 
 Layout contract:
-- m16  [F, blocks, sectors] uint16: the little-endian u16 view of the
-  fragment bytes (a bitcast, same embedding as pf.pack_bytes width 2);
-- alpha limb planes [limbs, 2, sectors] uint32: (a & 0xFFFF, a >> 16)
-  per MAC limb;
-- prf  [F, limbs, blocks] uint32 (limb-major so the block axis is the
-  128-lane axis);
-- out  [F, limbs, blocks] uint32 tags, transposed by the caller to the
+- data [F, blocks, 2*sectors] uint8 (a reshape of the fragment bytes);
+- w0/w1 [limbs, 2*sectors] int32: the 16-bit limbs of W per MAC limb;
+- prf   [F, limbs, blocks] uint32 (limb-major: block axis on lanes);
+- out   [F, limbs, blocks] uint32, transposed by the caller to the
   protocol's [F, blocks, limbs].
 
-The grid walks (fragment, block-tile); each step MACs a
-[BT, sectors] tile with all partial products < 2^24, so plain uint32
-accumulation over sectors <= 256 is exact (see pf.dot_u16_deferred,
-whose math this kernel inlines). Interpret mode runs the identical
-kernel on the CPU test mesh; tests pin it byte-equal to the jnp path.
+Interpret mode runs the identical kernel on the CPU test mesh; tests
+pin it byte-equal to the jnp path.
 """
 from __future__ import annotations
 
@@ -37,6 +47,7 @@ from jax.experimental.pallas import tpu as pltpu
 from . import pfield as pf
 
 DEFAULT_BLOCK_TILE = 256
+_CHUNK = 256        # max exactly-accumulable terms per 32-bit sum
 
 
 def _target_platform() -> str:
@@ -51,51 +62,51 @@ def _target_platform() -> str:
     return jax.default_backend()
 
 
-def _kernel(limbs: int):
-    def kernel(a_ref, f_ref, m_ref, out_ref):
-        # Mosaic has no unsigned reductions: accumulate in int32 —
-        # every partial product is < 2^24 and the 256-term sum < 2^32,
-        # so int32 wraparound is the BIT-EXACT uint32 sum; a bitcast
-        # recovers it before the modular fold
-        m = m_ref[0].astype(jnp.int32)             # [bt, s]
-        mlo = m & 0xFF
-        mhi = m >> 8
+def _kernel(limbs: int, lanes: int):
+    chunk = min(_CHUNK, lanes)
 
-        def usum(x):
-            return jax.lax.bitcast_convert_type(
-                jnp.sum(x, axis=1, dtype=jnp.int32), jnp.uint32)
+    def kernel(w0_ref, w1_ref, f_ref, d_ref, out_ref):
+        d = d_ref[0].astype(jnp.int32)             # [bt, lanes]
+
+        def fold(t):
+            """Exact 32-bit chunk sums -> one field element [bt]."""
+            acc = None
+            for lo in range(0, lanes, chunk):
+                s = jax.lax.bitcast_convert_type(
+                    jnp.sum(t[:, lo:lo + chunk], axis=1,
+                            dtype=jnp.int32), jnp.uint32)
+                s = pf.to_field(s)
+                acc = s if acc is None else pf.addmod(acc, s)
+            return acc
 
         for limb in range(limbs):
-            a0 = a_ref[limb, 0][None, :]           # [1, s] int32
-            a1 = a_ref[limb, 1][None, :]
-            s00 = usum(mlo * a0)
-            s10 = usum(mhi * a0)
-            s01 = usum(mlo * a1)
-            s11 = usum(mhi * a1)
-            acc = pf.addmod(
-                pf.addmod(pf.to_field(s00),
-                          pf.rotk(pf.to_field(s10), 8)),
-                pf.addmod(pf.rotk(pf.to_field(s01), 16),
-                          pf.rotk(pf.to_field(s11), 24)))
-            out_ref[0, limb] = pf.addmod(f_ref[0, limb], acc)
+            acc0 = fold(d * w0_ref[limb][None, :])
+            acc1 = fold(d * w1_ref[limb][None, :])
+            out_ref[0, limb] = pf.addmod(
+                f_ref[0, limb], pf.addmod(acc0, pf.rotk(acc1, 16)))
+
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5))
-def _tags_3d(alpha_planes: jax.Array, prf: jax.Array, m16: jax.Array,
-             limbs: int, sectors: int, block_tile: int) -> jax.Array:
-    """[F, blocks, s] u16 + [F, limbs, blocks] PRF -> [F, limbs, blocks]."""
-    fcount, blocks, _ = m16.shape
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _tags_3d(w0: jax.Array, w1: jax.Array, prf: jax.Array,
+             data: jax.Array, limbs: int, lanes: int,
+             block_tile: int) -> jax.Array:
+    """data [F, blocks, lanes] u8 + prf [F, limbs, blocks] ->
+    [F, limbs, blocks] tags."""
+    fcount, blocks, _ = data.shape
     interpret = _target_platform() != "tpu"
     return pl.pallas_call(
-        _kernel(limbs),
+        _kernel(limbs, lanes),
         grid=(fcount, blocks // block_tile),
         in_specs=[
-            pl.BlockSpec((limbs, 2, sectors), lambda i, t: (0, 0, 0),
+            pl.BlockSpec((limbs, lanes), lambda i, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((limbs, lanes), lambda i, t: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, limbs, block_tile), lambda i, t: (i, 0, t),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_tile, sectors), lambda i, t: (i, t, 0),
+            pl.BlockSpec((1, block_tile, lanes), lambda i, t: (i, t, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, limbs, block_tile),
@@ -104,14 +115,33 @@ def _tags_3d(alpha_planes: jax.Array, prf: jax.Array, m16: jax.Array,
         out_shape=jax.ShapeDtypeStruct((fcount, limbs, blocks),
                                        jnp.uint32),
         interpret=interpret,
-    )(alpha_planes, prf, m16)
+    )(w0, w1, prf, data)
 
 
 def supported(sectors: int, blocks: int) -> bool:
     """The fused path's shape envelope; callers fall back to the jnp
-    path outside it (protocol results are identical either way)."""
-    return (sectors <= 256 and sectors % 128 == 0
+    path outside it (protocol results are identical either way).
+    Deliberately narrow: sectors == 256 (the protocol geometry,
+    512 byte lanes) is the only shape validated through the real
+    Mosaic toolchain — this remote compiler ICEs on patterns that
+    interpret mode happily runs, so an interpret-green shape is NOT
+    evidence the TPU path works (review-caught when a vacuous bound
+    replaced the alignment gate)."""
+    return (sectors == 256
             and blocks % min(blocks, DEFAULT_BLOCK_TILE) == 0)
+
+
+@functools.lru_cache(maxsize=16)
+def _weight_limbs(alpha_key) -> tuple[np.ndarray, np.ndarray]:
+    """(w0, w1) int32 [limbs, 2*sectors] from alpha bytes (cached on
+    the raw key material — numpy only, never tracers)."""
+    sectors, limbs, raw = alpha_key
+    alpha = np.frombuffer(raw, dtype=np.uint32).reshape(
+        sectors, limbs).astype(np.uint64)
+    w = np.empty((limbs, 2 * sectors), dtype=np.uint64)
+    w[:, 0::2] = alpha.T
+    w[:, 1::2] = (alpha.T * 256) % pf.P
+    return ((w & 0xFFFF).astype(np.int32), (w >> 16).astype(np.int32))
 
 
 def tag_fragments_fused(alpha: jax.Array, prf: jax.Array,
@@ -120,13 +150,13 @@ def tag_fragments_fused(alpha: jax.Array, prf: jax.Array,
     tags [F, blocks, limbs] (the tag_from_elems contract, fused)."""
     fcount, nbytes = fragments.shape
     sectors, limbs = alpha.shape
-    blocks = nbytes // (sectors * pf.BYTES_PER_ELEM)
-    m16 = jax.lax.bitcast_convert_type(
-        fragments.reshape(fcount, blocks * sectors, 2),
-        jnp.uint16).reshape(fcount, blocks, sectors)
-    planes = jnp.stack([alpha.T & 0xFFFF, alpha.T >> 16],
-                       axis=1).astype(jnp.int32)    # [limbs, 2, s]
+    lanes = 2 * sectors
+    blocks = nbytes // lanes
+    alpha_np = np.asarray(jax.device_get(alpha), dtype=np.uint32)
+    w0, w1 = _weight_limbs((sectors, limbs, alpha_np.tobytes()))
     tile = min(blocks, DEFAULT_BLOCK_TILE)
-    out = _tags_3d(planes, jnp.moveaxis(prf, -1, 1), m16,
-                   limbs, sectors, tile)
+    out = _tags_3d(jnp.asarray(w0), jnp.asarray(w1),
+                   jnp.moveaxis(prf, -1, 1),
+                   fragments.reshape(fcount, blocks, lanes),
+                   limbs, lanes, tile)
     return jnp.moveaxis(out, 1, -1)                 # [F, blocks, limbs]

@@ -334,3 +334,42 @@ def test_fillerless_miner_proof_width_limbs3():
     tee.blocks = 16
     idx, nu = podr2.gen_challenge(b"seed", 16)
     assert TeeAgent._verify(tee, blob, [], b"seed", idx, nu)
+
+
+def test_tag_fragments_with_traced_key_falls_back():
+    """Review-caught: the fused kernel precomputes weights host-side,
+    so a key passed as a TRACED jit argument must route to the jnp
+    path (identical results) instead of crashing on device_get."""
+    import jax
+
+    key = podr2.Podr2Key.generate(44)
+    frags = make_fragments(2, seed=23)
+    ids = jnp.arange(2)
+
+    @jax.jit
+    def tag_with_key(alpha, prf_key, f):
+        k = podr2.Podr2Key(alpha=alpha, prf_key=prf_key)
+        return podr2.tag_fragments(k, ids, f)
+
+    got = np.asarray(tag_with_key(key.alpha, key.prf_key,
+                                  jnp.asarray(frags)))
+    want = np.asarray(podr2.tag_fragments(key, ids, frags))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_envelope_is_protocol_geometry_only():
+    """Only sectors == 256 (the single Mosaic-validated shape) may
+    route into the kernel; everything else takes the jnp path."""
+    from cess_tpu.ops import podr2_pallas
+
+    assert podr2_pallas.supported(256, 16)
+    assert podr2_pallas.supported(256, 16384)
+    for sectors in (64, 96, 128, 255):
+        assert not podr2_pallas.supported(sectors, 256)
+    # non-256 sectors still tag correctly (jnp route)
+    params = podr2.Podr2Params(sectors=128)
+    key = podr2.Podr2Key.generate(45, params)
+    frag = np.random.default_rng(1).integers(
+        0, 256, (1, 8 * 128 * 2), dtype=np.uint8)
+    tags = podr2.tag_fragments(key, jnp.arange(1), frag)
+    assert tags.shape == (1, 8, 2)
