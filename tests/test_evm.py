@@ -925,3 +925,41 @@ def test_eth_misc_tooling_probes():
     node.block_bodies.pop(1)
     assert srv.handle("eth_getBlockTransactionCountByNumber",
                       ["0x1"]) is None
+
+
+def test_reentrant_value_call_cannot_double_spend(rt):
+    """A contract that re-enters its caller mid-value-flow cannot
+    mint: every frame's transfers live in its own overlay, and the
+    total EVM-domain balance is conserved across arbitrary CALL
+    nesting."""
+    rt.apply_extrinsic("dev", "evm.deposit", 100 * D)
+    # ping: on call, CALLs the address in calldata forwarding half its
+    # callvalue; the callee is pong, which calls BACK into ping. The
+    # chain ends naturally when a deep frame's empty calldata targets
+    # the zero address (the host depth cap has its own tests)
+    pong = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        0, 0, 0, 0,
+        2, "CALLVALUE", "DIV",
+        0, "CALLDATALOAD",
+        50_000, "CALL", "POP", "STOP")))
+    ping = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        0, 0, "CALLDATASIZE", 0,
+        2, "CALLVALUE", "DIV",
+        int.from_bytes(pong, "big"),
+        200_000, "CALL", "POP", "STOP")))
+    def ledger_total():
+        return sum(v for _, v in
+                   rt.state.iter_prefix("evm", "balance"))
+
+    assert ledger_total() == 100 * D
+    rt.apply_extrinsic("dev", "evm.call", ping, word(ping), 500_000, 64)
+    # the WHOLE ledger is conserved — including the zero address,
+    # where a deep frame's empty calldata makes CALLDATALOAD(0) target
+    # 0x00 and strand a few units (faithful EVM semantics)
+    assert ledger_total() == 100 * D
+    assert rt.evm.balance("dev") == 100 * D - 64
+    burned = rt.evm.balance_of(b"\x00" * 20)
+    assert rt.evm.balance_of(ping) + rt.evm.balance_of(pong) \
+        + burned == 64
+    assert burned < 64 // 8      # only the deep tail strands

@@ -1,0 +1,65 @@
+"""secp256k1 sign/recover (the 0x1 precompile's backing math)."""
+import hashlib
+
+import numpy as np
+import pytest
+
+from cess_tpu.crypto import secp256k1 as k1
+
+
+def test_sign_recover_roundtrip_many():
+    rng = np.random.default_rng(7)
+    for i in range(20):
+        secret = int(rng.integers(1, 2**62)) * 2**160 + i + 1
+        h = hashlib.sha256(b"msg%d" % i).digest()
+        v, r, s = k1.sign(secret, h)
+        assert v in (27, 28)
+        assert 1 <= r < k1.N and 1 <= s <= k1.N // 2   # low-s
+        assert k1.recover_address(h, v, r, s) == k1.address_of(secret)
+
+
+def test_recover_rejects_out_of_range_components():
+    h = hashlib.sha256(b"edge").digest()
+    v, r, s = k1.sign(0xB0B, h)
+    good = k1.recover_address(h, v, r, s)
+    assert good == k1.address_of(0xB0B)
+    # v outside {27, 28}
+    for bad_v in (0, 1, 26, 29, 255):
+        assert k1.recover(h, bad_v, r, s) is None
+    # zero / >= N components
+    assert k1.recover(h, v, 0, s) is None
+    assert k1.recover(h, v, r, 0) is None
+    assert k1.recover(h, v, k1.N, s) is None
+    assert k1.recover(h, v, r, k1.N + 5) is None
+    # r not an x-coordinate on the curve (overwhelmingly likely for
+    # r+1 when r is): either None or a DIFFERENT address — never the
+    # signer's
+    got = k1.recover_address(h, v, (r % (k1.N - 2)) + 1, s)
+    assert got != good
+
+
+def test_signature_binds_message():
+    h1 = hashlib.sha256(b"pay alice 1").digest()
+    h2 = hashlib.sha256(b"pay mallory 9999").digest()
+    v, r, s = k1.sign(0x5EED, h1)
+    assert k1.recover_address(h1, v, r, s) == k1.address_of(0x5EED)
+    # same signature against another message recovers a different key
+    assert k1.recover_address(h2, v, r, s) != k1.address_of(0x5EED)
+
+
+def test_deterministic_nonce():
+    """RFC 6979: signing is deterministic — same (key, msg) -> same
+    signature on every replica, no RNG in consensus-adjacent code."""
+    h = hashlib.sha256(b"det").digest()
+    assert k1.sign(0xABC, h) == k1.sign(0xABC, h)
+    assert k1.sign(0xABC, h) != k1.sign(0xABD, h)
+
+
+def test_high_s_normalization_verifies():
+    """The complement (N - s, flipped recid) is the high-s twin; our
+    signer never emits it, but recovery handles both polarities."""
+    h = hashlib.sha256(b"twin").digest()
+    v, r, s = k1.sign(0xF00D, h)
+    twin_v = 27 + ((v - 27) ^ 1)
+    assert k1.recover_address(h, twin_v, r, k1.N - s) \
+        == k1.address_of(0xF00D)
