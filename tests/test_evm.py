@@ -963,3 +963,21 @@ def test_reentrant_value_call_cannot_double_spend(rt):
     assert rt.evm.balance_of(ping) + rt.evm.balance_of(pong) \
         + burned == 64
     assert burned < 64 // 8      # only the deep tail strands
+
+
+def test_call_depth_cap_bounds_self_recursion(rt):
+    """The host caps nested CALL frames at Evm.MAX_CALL_DEPTH: a
+    self-recursive contract executes exactly 1 + MAX_CALL_DEPTH frames
+    (the attempt FROM the deepest frame fails cleanly, success=0)."""
+    from cess_tpu.chain.evm import Evm
+
+    # increment slot 0, CALL self (address from calldata), store the
+    # inner success flag at slot 1, STOP
+    rec = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        0, "SLOAD", 1, "ADD", 0, "SSTORE",
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        0, 0, "CALLDATASIZE", 0, 0,
+        0, "CALLDATALOAD", 500_000, "CALL",
+        1, "SSTORE", "STOP")))
+    rt.apply_extrinsic("dev", "evm.call", rec, word(rec), 5_000_000)
+    assert rt.evm.storage_at(rec, 0) == 1 + Evm.MAX_CALL_DEPTH
