@@ -15,9 +15,9 @@ split into 16-bit limbs (w0, w1) host-side; every in-kernel partial
 product b_i * w ( < 2^8 * 2^16 = 2^24 ) accumulates exactly in 32-bit
 lanes over <= 256-term chunks (256 * 255 * 65535 < 2^32), with one
 modular fold per chunk per output element. Measured on v5e (r05):
-~6.4k frags/s for 8 MiB fragments at limbs=2 — vs ~3.1k for a u16
-bitcast variant and ~1.9k for the jnp path — because the kernel's HBM
-traffic is exactly one pass over the u8 input.
+~7.3k frags/s for 8 MiB fragments at limbs=2 (block tile 128) — vs
+~3.1k for a u16 bitcast variant and ~1.9k for the jnp path — because
+the kernel's HBM traffic is exactly one pass over the u8 input.
 
 Mosaic constraints shaping this design: no unsigned reductions (sums
 run in int32 and bitcast back — bit-exact below 2^32), no in-kernel
@@ -46,7 +46,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import pfield as pf
 
-DEFAULT_BLOCK_TILE = 256
+# v5e interleaved A/B sweep (r05): tile 128 runs ~7.3k frags/s vs
+# ~6.2k at 256 and ~6.4k at 512-1024 (8 MiB fragments, 128-resident)
+DEFAULT_BLOCK_TILE = 128
 _CHUNK = 256        # max exactly-accumulable terms per 32-bit sum
 
 
@@ -126,7 +128,14 @@ def supported(sectors: int, blocks: int) -> bool:
     Mosaic toolchain — this remote compiler ICEs on patterns that
     interpret mode happily runs, so an interpret-green shape is NOT
     evidence the TPU path works (review-caught when a vacuous bound
-    replaced the alignment gate)."""
+    replaced the alignment gate).
+
+    The block gate tracks DEFAULT_BLOCK_TILE: blocks must either fit
+    in one tile or divide it evenly. Retuning the tile (256 -> 128,
+    r05) therefore SHIFTS the envelope — e.g. blocks=192 now takes the
+    jnp fallback, blocks=384 now fuses — which is intended: every
+    admitted shape is the same kernel with a different grid count, and
+    tests/test_podr2.py pins the membership."""
     return (sectors == 256
             and blocks % min(blocks, DEFAULT_BLOCK_TILE) == 0)
 

@@ -981,3 +981,8 @@ def test_call_depth_cap_bounds_self_recursion(rt):
         1, "SSTORE", "STOP")))
     rt.apply_extrinsic("dev", "evm.call", rec, word(rec), 5_000_000)
     assert rt.evm.storage_at(rec, 0) == 1 + Evm.MAX_CALL_DEPTH
+    # the cap failure is CLEAN: the depth-8 frame's failed CALL pushed
+    # 0 without reverting, so its own slot-0 increment committed (the
+    # count above proves it) and the outermost frame's success flag —
+    # the last slot-1 write to commit — reads 1
+    assert rt.evm.storage_at(rec, 1) == 1

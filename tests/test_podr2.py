@@ -373,3 +373,17 @@ def test_fused_envelope_is_protocol_geometry_only():
         0, 256, (1, 8 * 128 * 2), dtype=np.uint8)
     tags = podr2.tag_fragments(key, jnp.arange(1), frag)
     assert tags.shape == (1, 8, 2)
+
+
+def test_fused_envelope_tracks_block_tile():
+    """The block gate follows DEFAULT_BLOCK_TILE (r05 retune 256->128
+    shifted membership in both directions — pin it): blocks fuse iff
+    they fit one tile or divide it evenly."""
+    from cess_tpu.ops import podr2_pallas as pp
+
+    tile = pp.DEFAULT_BLOCK_TILE
+    assert pp.supported(256, tile)           # one tile
+    assert pp.supported(256, 3 * tile)       # whole grid steps
+    assert pp.supported(256, tile // 2)      # sub-tile: tile == blocks
+    assert not pp.supported(256, tile + tile // 2)   # ragged grid
+    assert not pp.supported(256, 3 * tile // 2)
