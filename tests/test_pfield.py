@@ -146,4 +146,4 @@ def test_pack_bytes_device_bitcast_matches_numpy_oracle():
     np.testing.assert_array_equal(dev, host)
     # explicit endianness pin: bytes [lo, hi] -> lo | hi<<8
     two = np.array([[0x34, 0x12]], dtype=np.uint8)
-    assert int(np.asarray(pf.pack_bytes(jnp.asarray(two)))[0]) == 0x1234
+    assert int(np.asarray(pf.pack_bytes(jnp.asarray(two)))[0, 0]) == 0x1234
