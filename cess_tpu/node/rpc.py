@@ -565,6 +565,43 @@ class RpcServer:
                 return None
             full = bool(params[1]) if len(params) > 1 else False
             return self._eth_block(node, rt, header.number, full)
+        if method == "eth_getTransactionByBlockNumberAndIndex":
+            if len(params) < 2:
+                raise RpcError(INVALID_PARAMS, "expected [number, idx]")
+            try:
+                n = self._blocknum(params[0], node.head().number)
+                i = params[1]
+                i = int(i, 16) if isinstance(i, str) else int(i)
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
+            body = node.block_bodies.get(n)
+            if body is None or not 0 <= i < len(body.extrinsics):
+                return None
+            return self._tx_obj(node, rt, body.extrinsics[i], n, i)
+        if method == "eth_getBlockReceipts":
+            if not params:
+                raise RpcError(INVALID_PARAMS, "expected [number]")
+            try:
+                n = self._blocknum(params[0], node.head().number)
+            except (ValueError, TypeError) as e:
+                raise RpcError(INVALID_PARAMS, str(e)) from e
+            if not 0 <= n <= node.head().number:
+                return None
+            count = rt.state.get("ethereum", "count", n)
+            if count is None:
+                # pruned out of state (or an empty pre-receipt block):
+                # null, never a fabricated "no transactions"
+                return None
+            cumulative = 0
+            out = []
+            for i in range(count):
+                rc = rt.state.get("ethereum", "receipt", n, i)
+                if rc is None:
+                    continue
+                cumulative += rc[5]
+                out.append(self._receipt_obj(node, rt, n, i,
+                                             _cumulative=cumulative))
+            return out
         if method == "eth_estimateGas":
             if not params or not isinstance(params[0], dict):
                 raise RpcError(INVALID_PARAMS, "expected [call object]")
@@ -655,7 +692,8 @@ class RpcServer:
             "call": call,                   # framework extension
         }
 
-    def _receipt_obj(self, node, rt, block: int, idx: int):
+    def _receipt_obj(self, node, rt, block: int, idx: int,
+                     _cumulative: int | None = None):
         from ..chain.evm import eth_address
 
         rc = rt.state.get("ethereum", "receipt", block, idx)
@@ -664,11 +702,16 @@ class RpcServer:
         (txhash, signer, call, status, error, gas_used, contract,
          log_start, log_count) = rc
         bh = "0x" + self._canonical_hash(node, block).hex()
-        cumulative = 0
-        for i in range(idx + 1):
-            r2 = rt.state.get("ethereum", "receipt", block, i)
-            if r2 is not None:
-                cumulative += r2[5]
+        # whole-block serving passes the running sum; the single-tx
+        # path pays one prefix scan (an O(count^2) whole-block loop
+        # through this path was review-caught)
+        cumulative = _cumulative
+        if cumulative is None:
+            cumulative = 0
+            for i in range(idx + 1):
+                r2 = rt.state.get("ethereum", "receipt", block, i)
+                if r2 is not None:
+                    cumulative += r2[5]
         logs = []
         for seq in range(log_start, log_start + log_count):
             lg = rt.evm.log_at(block, seq)

@@ -986,3 +986,33 @@ def test_call_depth_cap_bounds_self_recursion(rt):
     # count above proves it) and the outermost frame's success flag —
     # the last slot-1 write to commit — reads 1
     assert rt.evm.storage_at(rec, 1) == 1
+
+
+def test_eth_block_receipts_and_tx_by_index():
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    spec = dev_spec()
+    node = Node(spec, "br", {"alice": spec.session_key("alice")})
+    srv = RpcServer(node, port=0)
+    node.submit_extrinsic("alice", "evm.deploy", TOKEN_INIT)
+    node.submit_extrinsic("alice", "system.remark", b"x")
+    node.try_author(1) and node.commit_proposal()
+    rcs = srv.handle("eth_getBlockReceipts", ["0x1"])
+    assert len(rcs) == 2
+    assert rcs[0]["contractAddress"] and rcs[0]["status"] == "0x1"
+    assert rcs[1]["call"] == "system.remark"
+    assert srv.handle("eth_getBlockReceipts", ["0x99"]) is None
+    # cumulative gas accumulates across the block
+    assert int(rcs[1]["cumulativeGasUsed"], 16) \
+        == int(rcs[0]["gasUsed"], 16) + int(rcs[1]["gasUsed"], 16)
+    # pruned-out receipt state answers null, never a fabricated []
+    node.runtime.state.delete("ethereum", "count", 1)
+    assert srv.handle("eth_getBlockReceipts", ["0x1"]) is None
+    tx0 = srv.handle("eth_getTransactionByBlockNumberAndIndex",
+                     ["0x1", "0x0"])
+    assert tx0["hash"] == rcs[0]["transactionHash"]
+    assert tx0["transactionIndex"] == "0x0"
+    assert srv.handle("eth_getTransactionByBlockNumberAndIndex",
+                      ["0x1", "0x9"]) is None
