@@ -1,0 +1,87 @@
+"""Named-task scheduler semantics (pallet-scheduler role,
+ref c-pallets/file-bank/src/lib.rs:102-104 usage): naming, overwrite,
+cancel, and the best-effort dispatch discipline — a failing or
+panicking task is dropped with an event and never wedges the block."""
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.state import DispatchError
+
+D = constants.DOLLARS
+
+
+@pytest.fixture
+def rt():
+    rt = Runtime(RuntimeConfig(era_blocks=1000))
+    rt.fund("alice", 1_000 * D)
+    return rt
+
+
+def test_named_schedule_dispatches_at_block(rt):
+    rt.scheduler.schedule_named("pay", rt.state.block + 3, "balances",
+                                "mint", "bob", 7 * D)
+    rt.advance_blocks(2)
+    assert rt.balances.free("bob") == 0          # not yet
+    rt.advance_blocks(1)
+    assert rt.balances.free("bob") == 7 * D      # fired exactly once
+    rt.advance_blocks(3)
+    assert rt.balances.free("bob") == 7 * D
+    # agenda + lookup fully consumed
+    assert rt.state.get("scheduler", "lookup", "pay") is None
+
+
+def test_same_name_overwrites_pending_task(rt):
+    at = rt.state.block + 2
+    rt.scheduler.schedule_named("job", at, "balances", "mint", "bob",
+                                1 * D)
+    # re-scheduling under the same name REPLACES (amount and block)
+    rt.scheduler.schedule_named("job", at + 1, "balances", "mint",
+                                "bob", 5 * D)
+    rt.advance_blocks(4)
+    assert rt.balances.free("bob") == 5 * D      # only the replacement
+
+
+def test_cancel_named_removes_task(rt):
+    at = rt.state.block + 2
+    rt.scheduler.schedule_named("gone", at, "balances", "mint", "bob",
+                                9 * D)
+    rt.scheduler.cancel_named("gone")
+    rt.scheduler.cancel_named("gone")            # idempotent
+    rt.advance_blocks(4)
+    assert rt.balances.free("bob") == 0
+    assert rt.state.get("scheduler", "agenda", at) is None
+
+
+def test_failing_task_drops_with_event_and_rolls_back(rt):
+    """A task whose dispatch fails (DispatchError) or panics
+    (TypeError) is dropped with a TaskFailed event; its writes roll
+    back; the block — and the other tasks in the same agenda — keep
+    going (FRAME scheduler's best-effort contract)."""
+    at = rt.state.block + 1
+    # transfer from a broke account -> DispatchError inside the task
+    rt.scheduler.schedule_named("bad", at, "balances", "transfer",
+                                "broke", "bob", 5 * D)
+    # malformed args -> TypeError inside the call (panicking task)
+    rt.scheduler.schedule_named("panic", at, "balances", "mint", "bob")
+    # and a good task in the SAME agenda still executes
+    rt.scheduler.schedule_named("good", at, "balances", "mint", "bob",
+                                2 * D)
+    rt.advance_blocks(1)
+    events = {dict(e.data)["name"]: dict(e.data)["error"]
+              for e in rt.state.events_of("scheduler", "TaskFailed")}
+    assert "bad" in events and "InsufficientBalance" in events["bad"]
+    assert "panic" in events and "TaskPanicked" in events["panic"]
+    assert "good" not in events
+    assert rt.balances.free("bob") == 2 * D
+    # chain is not wedged
+    rt.advance_blocks(2)
+    assert rt.balances.free("bob") == 2 * D
+
+
+def test_scheduler_not_dispatchable_from_transactions(rt):
+    """schedule_named is an INTERNAL pallet surface (file-bank's deal
+    timeouts); a signed extrinsic cannot reach it."""
+    with pytest.raises(DispatchError, match="UnknownCall"):
+        rt.apply_extrinsic("alice", "scheduler.schedule_named", "x", 5,
+                           "balances", "mint", "alice", 10 ** 9)
