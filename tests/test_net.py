@@ -97,8 +97,10 @@ def _assert_converged(results, min_finalized=2):
 
 def test_three_process_gossip_converges():
     # duration carries slack for CPU-contended full-suite runs: at
-    # SLOT=0.25 even a loaded box fits the needed slots in 9 s
-    _assert_converged(_run_cluster(duration=9.0))
+    # SLOT=0.25 an idle box needs ~3 s; 14 s absorbs a fully loaded
+    # host (9 s still flaked once when the whole suite + a bench run
+    # shared the box)
+    _assert_converged(_run_cluster(duration=14.0))
 
 
 def test_lossy_link_still_converges():
