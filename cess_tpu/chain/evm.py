@@ -255,8 +255,11 @@ class Evm:
             return n
 
     def code_at(self, address: bytes) -> bytes | None:
-        code = self.state.get(PALLET, "code", address)
-        return code if code else None
+        """None = no code entry at all; b"" = a contract whose init
+        returned empty runtime code (a real, distinct account state —
+        mainnet treats it as a plain account that accepts calls and
+        value, so conflating the two made its balance unreachable)."""
+        return self.state.get(PALLET, "code", address)
 
     def _check_gas(self, gas_limit) -> int:
         if not isinstance(gas_limit, int) or gas_limit <= 0:
@@ -365,8 +368,16 @@ class Evm:
             if depth >= self.MAX_CALL_DEPTH or static \
                     or len(init) > MAX_CODE:
                 return 0, b"", 0, []
+            if value and world.balance(frame_addr) < value:
+                # mainnet: insufficient-balance CREATE fails BEFORE the
+                # nonce bump (geth create() order)
+                return 0, b"", 0, []
+            # the nonce bump lands in the PARENT world, so it persists
+            # even when init reverts and the child overlay is discarded
+            # (mainnet semantics): a retried create gets a FRESH
+            # address instead of deterministically reusing the old one
+            nonce = world.next_nonce(frame_addr)
             child = Evm._World(self, parent=world)
-            nonce = child.next_nonce(frame_addr)
             if salt is None:
                 new = create_address(frame_addr, nonce)
             else:
@@ -448,6 +459,17 @@ class Evm:
         world = Evm._World(self)           # root: commits to chain
         if value and not world.transfer(caller, address, value):
             raise DispatchError("evm.InsufficientBalance")
+        if not code:
+            # empty runtime code (init returned b""): a plain account
+            # per mainnet — the call is a pure value transfer, so
+            # balance parked there stays reachable (the inner call_host
+            # already behaved this way; the top-level entry now agrees)
+            world.commit()
+            self.state.put(PALLET, "last_exec", (0, None))
+            self.state.deposit_event(PALLET, "Called", who=who,
+                                     address=address, out_len=0,
+                                     gas_used=0)
+            return b""
         try:
             res = evm_interp.execute(
                 code, calldata=calldata, gas_limit=gas_limit,
@@ -517,6 +539,10 @@ class Evm:
         caller_w = eth_address(caller)
         if value and not world.transfer(caller_w, address, value):
             raise DispatchError("evm.InsufficientBalance")
+        if not code:
+            # empty-code account: eth_call/estimate see a successful
+            # no-op transfer (mirrors call() above)
+            return evm_interp.ExecResult(output=b"", gas_used=0, logs=[])
         try:
             return evm_interp.execute(
                 code, calldata=calldata, gas_limit=gas_limit,

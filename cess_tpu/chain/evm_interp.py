@@ -29,6 +29,10 @@ Deliberate deviations from mainnet EVM, documented once:
   deviation above): sha256("evm-create2:" || creator20 || salt32 ||
   sha256(init))[:20] — deterministic and predictable by contracts
   using the same formula, which is the property EIP-1014 exists for.
+  The creator's nonce bump for CREATE/CREATE2 persists in the parent
+  frame even when init reverts (mainnet semantics; geth orders the
+  balance check before the bump, mirrored here) — a retried create
+  derives a fresh address rather than reusing the reverted one.
 - Precompiles 0x1-0x4 (ecrecover / sha256 / ripemd160 / identity)
   are serviced by the call host in evm.py; ecrecover's address
   derivation is sha3_256-based (crypto/secp256k1.py docstring).

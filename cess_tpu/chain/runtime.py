@@ -450,6 +450,22 @@ class Runtime:
                     "evm", "last_exec", default=(0, None))
         if txhash is None:
             return
+        prev = self.state.get("ethereum", "txloc", txhash)
+        if prev is not None:
+            # success-write-wins: a re-included duplicate (stale-nonce
+            # replay by a later block author) must not re-point
+            # eth_getTransactionReceipt at its failed dispatch — but a
+            # SUCCESSFUL re-execution of a tx whose first inclusion
+            # failed without consuming the nonce (e.g. CannotPayFee,
+            # then funded) must supersede the failed record, or the
+            # receipt would forever report failure for a transfer that
+            # actually moved funds
+            prev_rc = self.state.get("ethereum", "receipt", *prev)
+            if status == 0 or (prev_rc is not None and prev_rc[3] == 1):
+                return
+            # overwrite path: the old block's receipt row stays (an
+            # honest record of that block's failed attempt); only the
+            # hash -> location mapping moves to the succeeding dispatch
         log_count = self.evm.log_seq(block) - log_start
         self.state.put("ethereum", "txloc", txhash, (block, idx))
         self.state.put("ethereum", "receipt", block, idx,
@@ -485,7 +501,12 @@ class Runtime:
         count = self.state.get("ethereum", "count", stale, default=0)
         for idx in range(count):
             rc = self.state.get("ethereum", "receipt", stale, idx)
-            if rc is not None:
+            if rc is not None and self.state.get(
+                    "ethereum", "txloc", rc[0]) == (stale, idx):
+                # only drop the mapping if it still points HERE — a
+                # superseded failed inclusion's hash was re-pointed at
+                # a newer (still-retained) successful receipt, which
+                # must stay resolvable until ITS block ages out
                 self.state.delete("ethereum", "txloc", rc[0])
             self.state.delete("ethereum", "receipt", stale, idx)
         if count:

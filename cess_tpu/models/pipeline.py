@@ -49,13 +49,32 @@ class StoragePipeline:
     PoDR2 tags for fragments").
     """
 
-    def __init__(self, config: PipelineConfig, podr2_key: podr2.Podr2Key | None = None):
+    def __init__(self, config: PipelineConfig,
+                 podr2_key: podr2.Podr2Key | None = None, engine=None):
         self.config = config
         self.podr2_key = podr2_key or podr2.Podr2Key.generate(0, podr2.Podr2Params(config.sectors))
         strategy = config.strategy or default_strategy()
         self._parity = _MatrixApply(
             gf.cauchy_parity_matrix(config.k, config.m), strategy
         )
+        # optional submission engine (cess_tpu/serve): when configured,
+        # encode/tag submit through its batched queues so concurrent
+        # callers coalesce into shared device batches. The direct
+        # synchronous path below stays the default (trait-gate
+        # philosophy), and engine results are bit-identical to it.
+        self.engine = engine
+        if engine is not None and engine.codec is not None \
+                and (engine.codec.k, engine.codec.m) != (config.k, config.m):
+            raise ValueError(
+                f"engine codec RS({engine.codec.k},{engine.codec.m}) != "
+                f"pipeline RS({config.k},{config.m})")
+        if engine is not None and engine.audit is not None \
+                and not podr2.keys_equal(engine.audit.key,
+                                         self.podr2_key):
+            # a mismatched key would tag with DIFFERENT secrets than
+            # the direct path — silent protocol divergence
+            raise ValueError("engine AuditBackend key differs from "
+                             "the pipeline's PoDR2 key")
 
     def encode_step(self, segments: jnp.ndarray) -> jnp.ndarray:
         """[B, segment_size] uint8 -> [B, k+m, fragment_size] uint8.
@@ -67,6 +86,10 @@ class StoragePipeline:
         cfg = self.config
         b = segments.shape[0]
         data = segments.reshape(b, cfg.k, cfg.fragment_size)
+        if self.engine is not None and self.engine.codec is not None:
+            import numpy as np
+
+            return jnp.asarray(self.engine.encode(np.asarray(data)))
         parity = self._parity(data)
         return jnp.concatenate([data, parity], axis=-2)
 
@@ -87,7 +110,16 @@ class StoragePipeline:
             fragment_ids = jnp.asarray(fragment_ids)
             fragment_ids = fragment_ids.reshape(
                 (b * rows, 2) if fragment_ids.ndim == 3 else (b * rows,))
-        tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
+        if self.engine is not None and self.engine.audit is not None \
+                and fragment_ids.ndim == 2:
+            # engine tag class takes (lo, hi) id pairs; the arange
+            # bench default stays on the direct path
+            import numpy as np
+
+            tags = jnp.asarray(self.engine.tag_fragments(
+                np.asarray(fragment_ids), np.asarray(flat)))
+        else:
+            tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
         return tags.reshape(b, rows, *tags.shape[1:])
 
     def forward(self, segments: jnp.ndarray,

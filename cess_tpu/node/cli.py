@@ -91,6 +91,22 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry", default="",
                     help="stream per-block telemetry JSON lines to "
                          "this host:port endpoint")
+    ap.add_argument("--engine", default="off",
+                    choices=["off", "cpu", "auto", "tpu"],
+                    help="attach a device submission engine "
+                         "(cess_tpu/serve) as node.engine: dynamic "
+                         "micro-batching for the RS encode/repair hot "
+                         "paths with the chosen ErasureCodec backend, "
+                         "used by storage drivers embedding this node. "
+                         "The PoDR2 classes (tag/prove/verify) need "
+                         "the holder's secret key, so they activate "
+                         "only on engines the TEE/miner drivers build "
+                         "themselves (serve.make_engine(podr2_key=...))"
+                         ". Engine queue/batch/latency counters appear "
+                         "under cess_engine_* on GET /metrics and via "
+                         "the cess_engineStats RPC. 'off' (default) "
+                         "keeps every caller on the direct synchronous "
+                         "path")
     args = ap.parse_args(argv)
 
     def unhex(s: str) -> bytes:
@@ -213,6 +229,9 @@ def main(argv=None) -> int:
         from .metrics import TelemetryStream
 
         nodes[0].offchain_agents.append(TelemetryStream(args.telemetry))
+    engine = _make_cli_engine(args, spec)
+    if engine is not None:
+        nodes[0].engine = engine
     rpc = None
     import threading
 
@@ -243,7 +262,29 @@ def main(argv=None) -> int:
     finally:
         if rpc:
             rpc.stop()
+        if engine is not None:
+            engine.close()
     return 0
+
+
+def _make_cli_engine(args, spec):
+    """--engine: build a submission engine over the chain's RS
+    geometry with the requested ErasureCodec backend and attach it as
+    ``node.engine`` — the handle embedding code (gateway/miner/TEE
+    drivers constructed around this node, tests, notebooks) submits
+    through. RS-only: the PoDR2 secret never lives in the node, so the
+    audit classes stay inert here (drivers holding a key build their
+    own engine via serve.make_engine(podr2_key=...)). The CLI itself
+    spawns no storage agents, so with a bare node the flag's visible
+    effect is the stats surface: counters on GET /metrics
+    (cess_engine_*) and the cess_engineStats RPC."""
+    if args.engine == "off":
+        return None
+    from ..serve import make_engine
+
+    k = max(spec.fragment_count - 1, 1)      # reference RS(k, 1) shape
+    return make_engine(k, spec.fragment_count - k,
+                       rs_backend=args.engine)
 
 
 def _data_dir(args, spec) -> "str | None":
@@ -331,6 +372,9 @@ def _run_tcp_node(args, spec) -> int:
 
         node.offchain_agents.append(TelemetryStream(args.telemetry))
     peers = [int(p) for p in args.peers.split(",") if p.strip()]
+    engine = _make_cli_engine(args, spec)
+    if engine is not None:
+        node.engine = engine
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
     rpc = None
@@ -360,6 +404,8 @@ def _run_tcp_node(args, spec) -> int:
         svc.stop()
         if rpc:
             rpc.stop()
+        if engine is not None:
+            engine.close()
     return 0
 
 

@@ -51,6 +51,12 @@ def collect(node) -> dict[str, float]:
     m["cess_offences_total"] = len(st.events_of("offences"))
     m["cess_extrinsic_failed_total"] = len(
         st.events_of("system", "ExtrinsicFailed"))
+    # submission-engine counters (cess_tpu/serve): queue depth, batch
+    # occupancy, pad waste, latency percentiles per op class — merged
+    # into the same exposition when a node has an engine attached
+    engine = getattr(node, "engine", None)
+    if engine is not None:
+        m.update(engine.stats_metrics())
     return m
 
 

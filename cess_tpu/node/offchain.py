@@ -21,6 +21,15 @@ agents around a Node, driving the TPU data plane
 Every agent's ``on_block`` runs after each imported block (Substrate
 OCW semantics) and communicates ONLY via extrinsics + events + the
 fragment transfer channel, like the reference's network boundary.
+
+Device submission: each agent accepts an optional ``engine``
+(cess_tpu/serve) — OssGateway encodes/tags through its pipeline's
+engine, MinerAgent proves and RS-repairs through the prove/repair
+queues, TeeAgent verifies through the (highest-priority) verify
+queue. Results are bit-identical to the direct calls; None (the
+default) keeps every path direct and synchronous. ValidatorOcw has no
+device op on its path (challenge snapshots are chain-side host math),
+so it takes no engine.
 """
 from __future__ import annotations
 
@@ -151,11 +160,25 @@ def slow_filler_bytes(secret: bytes, index: int, size: int,
 
 class MinerAgent:
     def __init__(self, node: Node, account: str, gateways: list[OssGateway],
-                 pipeline: StoragePipeline):
+                 pipeline: StoragePipeline, engine=None):
         self.node = node
         self.account = account
         self.gateways = gateways
         self.pipeline = pipeline
+        # optional submission engine (cess_tpu/serve): proving and RS
+        # repair go through its prove/repair queues — concurrent miners
+        # answering the same round coalesce into shared device batches.
+        # None (default) keeps the direct synchronous path.
+        self.engine = engine
+        if engine is not None and engine.codec is not None \
+                and (engine.codec.k, engine.codec.m) \
+                != (pipeline.config.k, pipeline.config.m):
+            # loud at construction, like StoragePipeline/TeeAgent — a
+            # mismatched codec would feed repair wrong shard geometry
+            raise ValueError(
+                f"engine codec RS({engine.codec.k},{engine.codec.m}) != "
+                f"miner pipeline RS({pipeline.config.k},"
+                f"{pipeline.config.m})")
         self.store: dict[bytes, bytes] = {}        # fragment hash -> bytes
         self.tags: dict[bytes, np.ndarray] = {}
         self.filler_store: dict[bytes, bytes] = {}  # filler hash -> bytes
@@ -241,9 +264,10 @@ class MinerAgent:
         snap = next(s for s in ch.miners if s.miner == self.account)
         limbs = self.pipeline.podr2_key.limbs
         service = build_proof(seed, list(snap.service_frags), self.store,
-                              self.tags, limbs=limbs)
+                              self.tags, limbs=limbs, engine=self.engine)
         idle = build_proof(seed, list(snap.fillers), self.filler_store,
-                           self.filler_tags, limbs=limbs)
+                           self.filler_tags, limbs=limbs,
+                           engine=self.engine)
         node.submit_extrinsic(self.account, "audit.submit_proof",
                               idle, service)
 
@@ -277,11 +301,17 @@ class MinerAgent:
                 break
         if len(present) < cfg.k:
             return False
-        from ..ops.rs import make_codec
+        if self.engine is not None and self.engine.codec is not None:
+            rec = self.engine.reconstruct(np.stack(survivors),
+                                          tuple(present), (row,))
+            blob = np.asarray(rec)[0].tobytes()
+        else:
+            from ..ops.rs import make_codec
 
-        codec = make_codec(cfg.k, cfg.m, backend="auto")
-        rec = codec.reconstruct(np.stack(survivors), tuple(present), (row,))
-        blob = np.asarray(rec)[0].tobytes()
+            codec = make_codec(cfg.k, cfg.m, backend="auto")
+            rec = codec.reconstruct(np.stack(survivors), tuple(present),
+                                    (row,))
+            blob = np.asarray(rec)[0].tobytes()
         if fragment_hash(blob) != frag_hash:
             return False
         self.store[frag_hash] = blob
@@ -318,7 +348,7 @@ class Proof:
 def build_proof(seed: bytes, owed: list[bytes],
                 store: dict[bytes, bytes],
                 tags: dict[bytes, np.ndarray],
-                limbs: int | None = None) -> bytes:
+                limbs: int | None = None, engine=None) -> bytes:
     """Miner-side: aggregated proof over the owed set, as wire bytes.
     Fragments the miner no longer holds simply can't contribute — the
     fold then fails TEE verification (that's the audit)."""
@@ -343,8 +373,16 @@ def build_proof(seed: bytes, owed: list[bytes],
     idx, nu = podr2.gen_challenge(seed, blocks)
     ids = np.stack([podr2.fragment_id_from_hash(h) for h in held])
     r = podr2.aggregate_coeffs(seed, ids)
-    mu, sigma = podr2.prove_aggregate(jnp.asarray(frags),
-                                      jnp.asarray(tag_arr), idx, nu, r)
+    if engine is not None and engine.audit is not None:
+        # submission-engine path: miners answering the same round
+        # coalesce in the engine's prove queue (bit-identical fold)
+        mu, sigma = engine.prove_aggregate(frags, tag_arr,
+                                           np.asarray(idx),
+                                           np.asarray(nu), np.asarray(r))
+    else:
+        mu, sigma = podr2.prove_aggregate(jnp.asarray(frags),
+                                          jnp.asarray(tag_arr), idx, nu,
+                                          r)
     sigma = np.asarray(sigma)
     return codec.encode(Proof(mu=np.asarray(mu),
                               sigma=tuple(int(v) for v in sigma)))
@@ -355,11 +393,21 @@ class TeeAgent:
     proofs on device."""
 
     def __init__(self, node: Node, controller: str, key: podr2.Podr2Key,
-                 blocks_per_fragment: int, bls_seed: bytes | None = None):
+                 blocks_per_fragment: int, bls_seed: bytes | None = None,
+                 engine=None):
         self.node = node
         self.controller = controller
         self.key = key
         self.blocks = blocks_per_fragment
+        # optional submission engine (cess_tpu/serve): aggregated-proof
+        # checks route through its verify queue — the highest-priority
+        # class, so audit verification preempts bulk encode/tag work.
+        # The engine's AuditBackend must hold THIS TEE's key.
+        self.engine = engine
+        if engine is not None and engine.audit is not None \
+                and not podr2.keys_equal(engine.audit.key, key):
+            raise ValueError("engine AuditBackend key is not this "
+                             "TEE's PoDR2 key")
         self.account_key = node.spec.account_key(controller)
         self._submitted: set[tuple[str, int]] = set()
         # BLS verdict master key: registered on chain (with a PoP) so
@@ -486,6 +534,13 @@ class TeeAgent:
                 and not proof.mu.any()
         ids = np.stack([podr2.fragment_id_from_hash(h) for h in owed])
         r = podr2.aggregate_coeffs(seed, ids)
+        # getattr: tests construct partial TeeAgents via __new__
+        engine = getattr(self, "engine", None)
+        if engine is not None and engine.audit is not None:
+            return engine.verify_aggregate(
+                ids, self.blocks, np.asarray(idx), np.asarray(nu),
+                np.asarray(r), np.asarray(proof.mu),
+                np.asarray(proof.sigma, dtype=np.uint32))
         ok = podr2.verify_aggregate(self.key, jnp.asarray(ids), self.blocks,
                                     idx, nu, r,
                                     jnp.asarray(proof.mu),

@@ -20,6 +20,7 @@ Methods:
   mmr_root, mmr_generateProof [number], mmr_verifyProof [...]
   (header-inclusion proofs; pallet-mmr role)
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
+  cess_engineStats   (submission-engine queue/batch/latency counters)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -287,6 +288,12 @@ class RpcServer:
             return {"verdicts": list(recs), "blsKeys": keys}
         if method == "cess_challenge":
             return rt.audit.challenge()
+        if method == "cess_engineStats":
+            # submission-engine debug surface (cess_tpu/serve): live
+            # queue depths + per-class batching/latency counters;
+            # null when the node runs without an engine
+            engine = getattr(node, "engine", None)
+            return None if engine is None else engine.stats_snapshot()
         if method == "system_version":
             from ..chain import migrations as _mig
 
@@ -589,8 +596,16 @@ class RpcServer:
                 return None
             count = rt.state.get("ethereum", "count", n)
             if count is None:
-                # pruned out of state (or an empty pre-receipt block):
-                # null, never a fabricated "no transactions"
+                # the 'count' key is only written when a receipt lands,
+                # so a canonical in-retention block with no signed
+                # extrinsics has none — the spec shape for an existing
+                # empty block is [], not null. null stays reserved for
+                # blocks pruned out of state / outside retention,
+                # which tooling must treat as unknown
+                pruned_to = rt.state.get("ethereum", "pruned_to",
+                                         default=0)
+                if n >= pruned_to and n < len(node.chain):
+                    return []
                 return None
             cumulative = 0
             out = []

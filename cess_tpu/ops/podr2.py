@@ -112,6 +112,20 @@ class Podr2Key:
         return Podr2Key(alpha=alpha, prf_key=k_prf)
 
 
+def keys_equal(a: Podr2Key, b: Podr2Key) -> bool:
+    """Value equality of two PoDR2 keys (alpha + PRF key material).
+
+    Security-sensitive single source of truth: components that accept
+    an externally-built device stack (e.g. a submission engine's
+    AuditBackend) must refuse a key that differs from their own, or
+    tags/verdicts silently diverge from the protocol."""
+    if a is b:
+        return True
+    return (np.array_equal(np.asarray(a.alpha), np.asarray(b.alpha))
+            and np.array_equal(jax.random.key_data(a.prf_key),
+                               jax.random.key_data(b.prf_key)))
+
+
 def fragment_id_from_hash(fragment_hash: bytes) -> np.ndarray:
     """Protocol fragment id = low 8 bytes of the on-chain fragment hash,
     as a (lo, hi) uint32 pair (x32 mode cannot carry 64-bit scalars).

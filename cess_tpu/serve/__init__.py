@@ -1,0 +1,27 @@
+"""cess_tpu.serve — the device submission engine.
+
+A dynamic micro-batching service between every off-chain client
+(OssGateway encode, MinerAgent proving, TeeAgent tagging/verification)
+and the ``ErasureCodec`` / ``AuditBackend`` device gates: bounded
+per-class queues, a size-or-deadline batcher that coalesces ragged
+requests into shape-bucketed device programs, explicit backpressure,
+and engine counters on the node metrics surface. See engine.py for
+the full design; the direct synchronous path stays the default
+everywhere an engine is not explicitly configured.
+"""
+from .engine import EngineFuture, SubmissionEngine, make_engine
+from .policy import (AdmissionPolicy, EngineClosed, EngineError,
+                     EngineSaturated, EngineTimeout)
+from .stats import EngineStats
+
+__all__ = [
+    "AdmissionPolicy",
+    "EngineClosed",
+    "EngineError",
+    "EngineFuture",
+    "EngineSaturated",
+    "EngineStats",
+    "EngineTimeout",
+    "SubmissionEngine",
+    "make_engine",
+]

@@ -1,0 +1,87 @@
+"""Shape buckets + compile-once program cache for the engine.
+
+Every distinct array shape handed to a jitted op is a fresh XLA
+compile; a serving layer that forwards each caller's ragged batch size
+verbatim spends its life recompiling (the Ragged Paged Attention
+lesson, PAPERS.md arxiv 2604.15464: coalesce ragged requests into a
+small set of shape-bucketed device programs). The engine therefore
+
+- pads every coalesced batch's leading (row) axis up to a bucket —
+  powers of two, clamped to the policy's row budget — so the device
+  only ever sees O(log max_rows) distinct shapes per op, and
+- memoizes the bound device callable per (op, bucket shape, aux key)
+  in :class:`ProgramCache`, so bucket reuse is visible in the stats
+  (``programs_built`` vs ``programs_reused``) and table builds
+  (nibble tables, bit-matrix expansion, decode-matrix Gauss-Jordan)
+  happen once per key rather than per call.
+
+Padding is with zero rows and is sliced off after the op; every engine
+op is row-independent (vmap / per-row matrix apply), so padded results
+are bit-identical to unpadded ones — the determinism tests in
+tests/test_serve.py pin this.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest power-of-two >= n — ALWAYS on the power-of-two grid.
+
+    Coalesced batches respect the policy row budget (the drain never
+    combines requests past max_batch_rows), so a bigger n happens only
+    for a single oversized request. That request still pads to the
+    next power of two rather than compiling an exact-size one-off
+    program: an irregular caller then costs at most O(log n) extra
+    programs and < 2x pad waste, never a compile per distinct size —
+    the churn this module exists to prevent."""
+    if n < 1:
+        raise ValueError(f"bucket for {n} rows")
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ProgramCache:
+    """(op, bucket shape, aux key) -> bound device callable, LRU.
+
+    The underlying jax.jit caches by traced shape anyway; this layer
+    exists so (a) host-side table/matrix builds are done once per key,
+    (b) the engine can report compile-vs-reuse counts, and (c) the
+    bucket policy has one place to be enforced.
+
+    Bounded: prove/verify keys embed the challenge-round digest, so a
+    long-running engine sees a stream of keys that are hot for one
+    audit round and dead afterwards — an unbounded dict would be a
+    slow leak of closures (and their captured round arrays). LRU with
+    a generous capacity keeps every live round's programs resident
+    while letting dead rounds fall out.
+    """
+
+    CAPACITY = 256
+
+    def __init__(self, stats=None, capacity: int = CAPACITY):
+        import collections
+
+        self._programs: "collections.OrderedDict[tuple, Callable]" = \
+            collections.OrderedDict()
+        self._stats = stats
+        self.capacity = capacity
+
+    def __len__(self) -> int:
+        return len(self._programs)
+
+    def get(self, key: tuple, build: Callable[[], Callable]) -> Callable:
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._programs[key] = build()
+            if self._stats is not None:
+                self._stats.programs_built += 1
+            while len(self._programs) > self.capacity:
+                self._programs.popitem(last=False)
+        else:
+            self._programs.move_to_end(key)
+            if self._stats is not None:
+                self._stats.programs_reused += 1
+        return prog

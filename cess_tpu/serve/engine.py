@@ -1,0 +1,733 @@
+"""The device submission engine: dynamic micro-batching for the
+RS/PoDR2 hot paths.
+
+Every off-chain actor in the reference ecosystem hits the device
+through its own tiny synchronous call — OSS gateways encode uploads,
+miners prove challenges, TEEs tag and verify — leaving the accelerator
+idle between calls and recompiling on every new shape. This engine is
+the serving layer between all of them and the ``ErasureCodec`` /
+``AuditBackend`` gates (ops/rs.py, ops/audit_backend.py):
+
+- callers ``submit_*`` and get a future back; per-op-class bounded
+  queues hold the requests (policy.py: explicit backpressure, class
+  priority, deadlines);
+- one batcher thread drains a class on a size-or-deadline trigger,
+  coalesces coalescible requests (same op, geometry and round
+  parameters) into a single device batch, pads the batch to a shape
+  bucket (buckets.py: compile-once program cache), launches it, and
+  slices results back per request;
+- everything observable lands in stats.py (queue depth, batch
+  occupancy, pad waste, per-class latency percentiles), exported via
+  node/metrics.py and the ``cess_engineStats`` RPC.
+
+Protocol determinism is the hard constraint: engine-mediated results
+are bit-identical to the direct calls. That falls out of two facts —
+every coalesced op is row-independent (vmap / per-row GF matrix
+apply), and padding adds zero rows (or zero aggregation coefficients,
+whose terms are exact modular zeros) that are sliced off afterward.
+tests/test_serve.py pins both.
+
+The direct synchronous path remains the default everywhere (the
+trait-gate philosophy): an engine is used only where one is explicitly
+configured (StoragePipeline(engine=...), MinerAgent(engine=...),
+TeeAgent(engine=...), ``node.cli --engine``).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from .buckets import ProgramCache, bucket_rows
+from .policy import (CLASSES, AdmissionPolicy, EngineClosed,
+                     EngineSaturated, EngineTimeout)
+from .stats import EngineStats
+
+
+class EngineFuture:
+    """Result handle for a submitted request (threading-based: the
+    engine serves plain synchronous agents, not an event loop)."""
+
+    __slots__ = ("_event", "_value", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._exc: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        """Block until resolved. Raises the request's failure
+        (EngineTimeout on deadline cancellation, the op's error on a
+        batch failure) or EngineTimeout if ``timeout`` elapses first."""
+        if not self._event.wait(timeout):
+            raise EngineTimeout(f"no result within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    # engine-internal
+    def _resolve(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def _reject(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+
+@dataclasses.dataclass
+class _Request:
+    cls: str                 # op class (policy.CLASSES)
+    key: tuple               # coalescing key: op + geometry + round aux
+    rows: int                # device rows this request contributes
+    arrays: dict             # normalized numpy payloads
+    aux: dict                # shared parameters (idx/nu/present/...)
+    enqueue_t: float
+    deadline: float | None
+    future: EngineFuture
+    squeeze: bool = False    # 2-D submit: drop the batch axis on return
+
+
+def _round_digest(num_blocks: int, idx, nu) -> bytes:
+    """Coalescing identity of a challenge round's derived parameters."""
+    h = hashlib.sha256(num_blocks.to_bytes(8, "little"))
+    h.update(np.asarray(idx).tobytes())
+    h.update(np.asarray(nu).tobytes())
+    return h.digest()[:16]
+
+
+def _pad_axis0(arr: np.ndarray, rows: int) -> np.ndarray:
+    if arr.shape[0] == rows:
+        return arr
+    pad = np.zeros((rows - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+class SubmissionEngine:
+    """See module docstring. Construct via :func:`make_engine` or pass
+    an ``ErasureCodec`` (ops/rs.py gate) and optionally an
+    ``AuditBackend`` (ops/audit_backend.py gate) directly."""
+
+    def __init__(self, codec=None, audit=None,
+                 policy: AdmissionPolicy | None = None):
+        if codec is None and audit is None:
+            raise ValueError("engine needs a codec and/or audit backend")
+        self.codec = codec
+        self.audit = audit
+        self.policy = policy or AdmissionPolicy()
+        self.stats = EngineStats()
+        self.programs = ProgramCache(self.stats)
+        self._queues: dict[str, collections.deque[_Request]] = {
+            c: collections.deque() for c in CLASSES}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._flushing = 0       # active flush() calls force draining
+        self._inflight = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cess-submission-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # submission API — each submit_* returns an EngineFuture; the
+    # same-named plain method is the blocking convenience form.
+    # ------------------------------------------------------------------
+
+    # -- encode (ErasureCodec) ----------------------------------------
+    def submit_encode(self, data, timeout: float | None = None) -> EngineFuture:
+        """data [B, k, n] (or [k, n]) uint8 -> future of [B, k+m, n]."""
+        self._need_codec()
+        data, squeeze = self._norm_shards(data, self.codec.k)
+        key = ("encode", data.shape[1], data.shape[2])
+        return self._submit("encode", key, data.shape[0],
+                            {"data": data}, {}, timeout, squeeze)
+
+    def encode(self, data, timeout: float | None = None) -> np.ndarray:
+        return self.submit_encode(data, timeout).result()
+
+    # -- decode / repair (ErasureCodec) --------------------------------
+    def submit_reconstruct(self, survivors, present, missing=None,
+                           timeout: float | None = None) -> EngineFuture:
+        """survivors [B, k, n] (or [k, n]) rows ordered as ``present``
+        -> future of the recovered [B, len(missing), n] shards."""
+        self._need_codec()
+        present = tuple(present)
+        if missing is None:
+            missing = tuple(i for i in range(self.codec.k + self.codec.m)
+                            if i not in present)
+        survivors, squeeze = self._norm_shards(survivors, len(present))
+        key = ("repair", "reconstruct", present, tuple(missing),
+               survivors.shape[2])
+        return self._submit("repair", key, survivors.shape[0],
+                            {"survivors": survivors},
+                            {"present": present, "missing": tuple(missing)},
+                            timeout, squeeze)
+
+    def reconstruct(self, survivors, present, missing=None,
+                    timeout: float | None = None) -> np.ndarray:
+        return self.submit_reconstruct(survivors, present, missing,
+                                       timeout).result()
+
+    def submit_decode_data(self, survivors, present,
+                           timeout: float | None = None) -> EngineFuture:
+        self._need_codec()
+        present = tuple(present)
+        survivors, squeeze = self._norm_shards(survivors, len(present))
+        key = ("repair", "decode", present, (), survivors.shape[2])
+        return self._submit("repair", key, survivors.shape[0],
+                            {"survivors": survivors},
+                            {"present": present}, timeout, squeeze)
+
+    def decode_data(self, survivors, present,
+                    timeout: float | None = None) -> np.ndarray:
+        return self.submit_decode_data(survivors, present,
+                                       timeout).result()
+
+    # -- tag (AuditBackend, TEE role) ----------------------------------
+    def submit_tag(self, fragment_ids, fragments,
+                   timeout: float | None = None) -> EngineFuture:
+        """ids [F, 2] uint32, fragments [F, bytes] uint8 -> future of
+        tags [F, blocks, limbs]."""
+        self._need_audit()
+        ids = np.ascontiguousarray(np.asarray(fragment_ids,
+                                              dtype=np.uint32))
+        frags = np.ascontiguousarray(np.asarray(fragments,
+                                                dtype=np.uint8))
+        if ids.ndim != 2 or ids.shape[1] != 2 or frags.ndim != 2 \
+                or ids.shape[0] != frags.shape[0]:
+            raise ValueError("expected ids [F, 2] and fragments [F, bytes]")
+        key = ("tag", frags.shape[1])
+        return self._submit("tag", key, frags.shape[0],
+                            {"ids": ids, "fragments": frags}, {}, timeout)
+
+    def tag_fragments(self, fragment_ids, fragments,
+                      timeout: float | None = None) -> np.ndarray:
+        return self.submit_tag(fragment_ids, fragments, timeout).result()
+
+    # -- prove (miner role) --------------------------------------------
+    def submit_prove_aggregate(self, fragments, tags, idx, nu, r,
+                               sectors: int | None = None,
+                               timeout: float | None = None) -> EngineFuture:
+        """One miner's aggregated proof over its held set: fragments
+        [F, bytes], tags [F, blocks, limbs], coefficients r [F] ->
+        future of (mu [sectors], sigma [limbs]). Requests from miners
+        answering the SAME round (same idx/nu) coalesce into one
+        F-padded vmap batch; r's zero padding contributes exact
+        modular zeros to the fold, so results are bit-identical."""
+        self._need_audit()
+        from ..ops import podr2
+
+        frags = np.ascontiguousarray(np.asarray(fragments, dtype=np.uint8))
+        tag_arr = np.ascontiguousarray(np.asarray(tags, dtype=np.uint32))
+        r_arr = np.ascontiguousarray(np.asarray(r, dtype=np.uint32))
+        idx = np.asarray(idx)
+        nu = np.asarray(nu)
+        if frags.ndim != 2 or tag_arr.ndim != 3 or r_arr.ndim != 1 \
+                or not frags.shape[0] == tag_arr.shape[0] == r_arr.shape[0]:
+            raise ValueError("expected fragments [F, bytes], tags "
+                             "[F, blocks, limbs], r [F]")
+        sectors = podr2.SECTORS if sectors is None else sectors
+        key = ("prove", frags.shape[1], tag_arr.shape[1],
+               tag_arr.shape[2], sectors,
+               _round_digest(tag_arr.shape[1], idx, nu))
+        return self._submit("prove", key, frags.shape[0],
+                            {"fragments": frags, "tags": tag_arr,
+                             "r": r_arr},
+                            {"idx": idx, "nu": nu, "sectors": sectors},
+                            timeout)
+
+    def prove_aggregate(self, fragments, tags, idx, nu, r,
+                        sectors: int | None = None,
+                        timeout: float | None = None):
+        return self.submit_prove_aggregate(fragments, tags, idx, nu, r,
+                                           sectors, timeout).result()
+
+    # -- verify (TEE role) ---------------------------------------------
+    def submit_verify_batch(self, fragment_ids, num_blocks, idx, nu,
+                            mu, sigma,
+                            timeout: float | None = None) -> EngineFuture:
+        """Per-fragment checks: ids [F, 2], mu [F, sectors], sigma
+        [F, limbs] -> future of bool [F]. Coalesces along F across
+        requests of the same round."""
+        self._need_audit()
+        ids = np.ascontiguousarray(np.asarray(fragment_ids,
+                                              dtype=np.uint32))
+        mu = np.ascontiguousarray(np.asarray(mu, dtype=np.uint32))
+        sigma = np.ascontiguousarray(np.asarray(sigma, dtype=np.uint32))
+        idx = np.asarray(idx)
+        nu = np.asarray(nu)
+        if ids.ndim != 2 or mu.ndim != 2 or sigma.ndim != 2 \
+                or not ids.shape[0] == mu.shape[0] == sigma.shape[0]:
+            raise ValueError("expected ids [F, 2], mu [F, s], sigma "
+                             "[F, limbs]")
+        key = ("verify_batch", num_blocks, mu.shape[1], sigma.shape[1],
+               _round_digest(num_blocks, idx, nu))
+        return self._submit("verify", key, ids.shape[0],
+                            {"ids": ids, "mu": mu, "sigma": sigma},
+                            {"idx": idx, "nu": nu,
+                             "num_blocks": num_blocks}, timeout)
+
+    def verify_batch(self, fragment_ids, num_blocks, idx, nu, mu, sigma,
+                     timeout: float | None = None) -> np.ndarray:
+        return self.submit_verify_batch(fragment_ids, num_blocks, idx,
+                                        nu, mu, sigma, timeout).result()
+
+    def submit_verify_aggregate(self, fragment_ids, num_blocks, idx, nu,
+                                r, mu, sigma,
+                                timeout: float | None = None) -> EngineFuture:
+        """One aggregated-proof check (TeeAgent's per-mission verify):
+        ids [F, 2], r [F], mu [sectors], sigma [limbs] -> future of
+        bool. Missions of the same round coalesce: each mission's owed
+        set is padded to a shared F bucket with r = 0 rows (exact
+        modular zeros in the fold) and the checks run as one vmap."""
+        self._need_audit()
+        ids = np.ascontiguousarray(np.asarray(fragment_ids,
+                                              dtype=np.uint32)).reshape(-1, 2)
+        r_arr = np.ascontiguousarray(np.asarray(r, dtype=np.uint32))
+        mu = np.ascontiguousarray(np.asarray(mu, dtype=np.uint32))
+        sigma = np.ascontiguousarray(np.asarray(sigma, dtype=np.uint32))
+        idx = np.asarray(idx)
+        nu = np.asarray(nu)
+        if r_arr.ndim != 1 or ids.shape[0] != r_arr.shape[0] \
+                or mu.ndim != 1 or sigma.ndim != 1:
+            raise ValueError("expected ids [F, 2], r [F], mu [s], "
+                             "sigma [limbs]")
+        key = ("verify_agg", num_blocks, mu.shape[0], sigma.shape[0],
+               _round_digest(num_blocks, idx, nu))
+        return self._submit("verify", key, ids.shape[0],
+                            {"ids": ids, "r": r_arr, "mu": mu,
+                             "sigma": sigma},
+                            {"idx": idx, "nu": nu,
+                             "num_blocks": num_blocks}, timeout)
+
+    def verify_aggregate(self, fragment_ids, num_blocks, idx, nu, r, mu,
+                         sigma, timeout: float | None = None) -> bool:
+        return bool(self.submit_verify_aggregate(
+            fragment_ids, num_blocks, idx, nu, r, mu, sigma,
+            timeout).result())
+
+    # ------------------------------------------------------------------
+    # lifecycle / introspection
+    # ------------------------------------------------------------------
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return self.stats.snapshot(
+                {c: len(q) for c, q in self._queues.items()})
+
+    def stats_metrics(self) -> dict[str, float]:
+        with self._lock:
+            return self.stats.metrics(
+                {c: len(q) for c, q in self._queues.items()})
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Force-drain everything queued and wait until it resolves
+        (no waiting out the coalescing delay). Returns False if the
+        timeout elapses first; queued work keeps draining regardless."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._flushing += 1
+            self._cond.notify_all()
+            try:
+                while any(self._queues.values()) or self._inflight:
+                    left = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if left is not None and left <= 0:
+                        return False
+                    self._cond.wait(left)
+            finally:
+                self._flushing -= 1
+        return True
+
+    def close(self, timeout: float | None = 5.0) -> None:
+        """Drain pending requests, then stop the batcher. Subsequent
+        submits raise EngineClosed.
+
+        If the drain outlives ``timeout``, every request still QUEUED
+        (not yet handed to the device) is rejected with EngineClosed so
+        no caller blocks forever on a future that will never fire —
+        the no-silent-drops contract extends to shutdown. A batch
+        already in flight still resolves if the process lives on."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            with self._cond:
+                for cls, q in self._queues.items():
+                    while q:
+                        r = q.popleft()
+                        self.stats.classes[cls].failed += 1
+                        r.future._reject(EngineClosed(
+                            "engine shut down before this request ran"))
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _need_codec(self) -> None:
+        if self.codec is None:
+            raise ValueError("engine has no ErasureCodec configured")
+
+    def _need_audit(self) -> None:
+        if self.audit is None:
+            raise ValueError("engine has no AuditBackend configured")
+
+    @staticmethod
+    def _norm_shards(data, rows: int) -> tuple[np.ndarray, bool]:
+        arr = np.ascontiguousarray(np.asarray(data, dtype=np.uint8))
+        squeeze = arr.ndim == 2
+        if squeeze:
+            arr = arr[None]
+        if arr.ndim != 3 or arr.shape[1] != rows:
+            raise ValueError(f"expected [B, {rows}, n] shards, got "
+                             f"{arr.shape}")
+        return arr, squeeze
+
+    def _submit(self, cls: str, key: tuple, rows: int, arrays: dict,
+                aux: dict, timeout: float | None,
+                squeeze: bool = False) -> EngineFuture:
+        if rows < 1:
+            raise ValueError(f"empty {cls} request (0 rows)")
+        now = time.monotonic()
+        if timeout is None:
+            timeout = self.policy.default_timeout
+        fut = EngineFuture()
+        req = _Request(cls=cls, key=key, rows=rows, arrays=arrays,
+                       aux=aux, enqueue_t=now,
+                       deadline=None if timeout is None else now + timeout,
+                       future=fut, squeeze=squeeze)
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("engine is shut down")
+            st = self.stats.classes[cls]
+            if len(self._queues[cls]) >= self.policy.queue_cap:
+                st.saturated += 1
+                raise EngineSaturated(
+                    f"{cls} queue full ({self.policy.queue_cap})")
+            st.submitted += 1
+            self._queues[cls].append(req)
+            self._cond.notify_all()
+        return fut
+
+    # -- batcher thread -------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            batch: list[_Request] = []
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    self._expire(now)
+                    cls = self._ready_class(now)
+                    if cls is not None:
+                        batch = self._drain(cls)
+                        self._inflight += 1
+                        break
+                    if self._closed:
+                        self._cond.notify_all()
+                        return
+                    self._cond.wait(self._wake_timeout(now))
+            try:
+                if batch:
+                    self._run_batch(batch)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _expire(self, now: float) -> None:
+        """Cancel EVERY queued request whose deadline passed, in every
+        class (lock held). Running before readiness checks means a dead
+        request in a quiet class cancels promptly even while other
+        classes carry traffic, never trips a spurious drain trigger,
+        and stops counting against its queue's cap."""
+        for cls, q in self._queues.items():
+            if not any(r.deadline is not None and r.deadline <= now
+                       for r in q):
+                continue
+            st = self.stats.classes[cls]
+            keep = []
+            for r in q:
+                if r.deadline is not None and r.deadline <= now:
+                    st.timeouts += 1
+                    r.future._reject(EngineTimeout(
+                        f"{cls} request deadline expired before "
+                        "batching"))
+                else:
+                    keep.append(r)
+            q.clear()
+            q.extend(keep)
+
+    def _ready_class(self, now: float) -> str | None:
+        """Class to drain now, or None to keep waiting.
+
+        A drain happens when ANY class trips a trigger — size
+        (requests or rows), deadline (oldest waited max_delay), an
+        active flush, or engine shutdown (drain everything). Once the
+        device is going to be fed, the HIGHEST-PRIORITY non-empty
+        class goes first regardless of which class tripped: a
+        just-arrived challenge verification preempts the bulk encode
+        whose delay expired (policy.py). Expired requests are gone
+        already (_expire runs first), so deadlines never trigger
+        drains."""
+        pol = self.policy
+        first_nonempty = None
+        for cls in CLASSES:               # priority order
+            q = self._queues[cls]
+            if not q:
+                continue
+            if first_nonempty is None:
+                first_nonempty = cls
+            if (self._closed or self._flushing
+                    or len(q) >= pol.max_batch_requests
+                    or q[0].enqueue_t + pol.max_delay <= now
+                    or sum(r.rows for r in q) >= pol.max_batch_rows):
+                return first_nonempty
+        return None
+
+    def _wake_timeout(self, now: float) -> float | None:
+        wake = None
+        for q in self._queues.values():
+            for r in q:
+                t = r.enqueue_t + self.policy.max_delay
+                if r.deadline is not None:
+                    t = min(t, r.deadline)
+                wake = t if wake is None else min(wake, t)
+        if wake is None:
+            return None
+        return max(wake - now, 0.0)
+
+    # ops that pad every request's OWN row axis to the batch-wide
+    # bucket (stacked, not concatenated): cap the bucket spread so one
+    # huge request cannot multiply the device work of its small peers
+    _STACKED_OPS = ("prove", "verify_agg")
+    PAD_SPREAD = 4
+
+    def _drain(self, cls: str) -> list[_Request]:
+        """Pop one coalescible batch (lock held): take queued requests
+        sharing the oldest request's key up to the size budgets;
+        others stay queued. Expired requests are already gone
+        (_expire runs under the same lock hold)."""
+        q = self._queues[cls]
+        if not q:
+            return []
+        first = q[0]
+        stacked = first.key[0] in self._STACKED_OPS
+        anchor_bucket = bucket_rows(first.rows)
+        batch, rest, rows = [], [], 0
+        for r in q:
+            fits = (not batch
+                    or (r.key == first.key
+                        and len(batch) < self.policy.max_batch_requests
+                        and rows + r.rows <= self.policy.max_batch_rows))
+            if fits and stacked and batch:
+                b = bucket_rows(r.rows)
+                fits = (b <= self.PAD_SPREAD * anchor_bucket
+                        and anchor_bucket <= self.PAD_SPREAD * b)
+            if fits:
+                batch.append(r)
+                rows += r.rows
+            else:
+                rest.append(r)
+        q.clear()
+        q.extend(rest)
+        return batch
+
+    def _run_batch(self, batch: list[_Request]) -> None:
+        cls = batch[0].cls
+        op = batch[0].key[0]
+        try:
+            runner: Callable = getattr(self, f"_op_{op}")
+            results, device_rows = runner(batch)
+        except Exception as e:        # op failure: reject the batch
+            with self._lock:
+                self.stats.classes[cls].failed += len(batch)
+            for r in batch:
+                r.future._reject(e)
+            return
+        done = time.monotonic()
+        real_rows = sum(r.rows for r in batch)
+        with self._lock:
+            st = self.stats.classes[cls]
+            st.batches += 1
+            st.batched_requests += len(batch)
+            st.rows += real_rows
+            st.padded_rows += max(device_rows - real_rows, 0)
+            st.completed += len(batch)
+            for r in batch:
+                st.latencies.append(done - r.enqueue_t)
+        for r, res in zip(batch, results):
+            r.future._resolve(res)
+
+    # -- op runners (batcher thread only) -------------------------------
+    def _split_rows(self, batch: list[_Request], out: np.ndarray) -> list:
+        results, off = [], 0
+        for r in batch:
+            piece = out[off:off + r.rows]
+            results.append(piece[0] if r.squeeze else piece)
+            off += r.rows
+        return results
+
+    def _op_encode(self, batch):
+        data = np.concatenate([r.arrays["data"] for r in batch], axis=0)
+        total = data.shape[0]
+        bucket = bucket_rows(total)
+        _, k, n = data.shape
+        prog = self.programs.get(("encode", k, n, bucket),
+                                 lambda: self.codec.encode)
+        out = np.asarray(prog(_pad_axis0(data, bucket)))[:total]
+        return self._split_rows(batch, out), bucket
+
+    def _op_repair(self, batch):
+        kind = batch[0].key[1]
+        aux = batch[0].aux
+        surv = np.concatenate([r.arrays["survivors"] for r in batch],
+                              axis=0)
+        total = surv.shape[0]
+        bucket = bucket_rows(total)
+        n = surv.shape[2]
+        if kind == "reconstruct":
+            present, missing = aux["present"], aux["missing"]
+            prog = self.programs.get(
+                ("repair", present, missing, n, bucket),
+                lambda: (lambda a: self.codec.reconstruct(a, present,
+                                                          missing)))
+        else:
+            present = aux["present"]
+            prog = self.programs.get(
+                ("decode", present, n, bucket),
+                lambda: (lambda a: self.codec.decode_data(a, present)))
+        out = np.asarray(prog(_pad_axis0(surv, bucket)))[:total]
+        return self._split_rows(batch, out), bucket
+
+    def _op_tag(self, batch):
+        ids = np.concatenate([r.arrays["ids"] for r in batch], axis=0)
+        frags = np.concatenate([r.arrays["fragments"] for r in batch],
+                               axis=0)
+        total = frags.shape[0]
+        bucket = bucket_rows(total)
+        nbytes = frags.shape[1]
+        prog = self.programs.get(("tag", nbytes, bucket),
+                                 lambda: self.audit.tag_fragments)
+        out = np.asarray(prog(_pad_axis0(ids, bucket),
+                              _pad_axis0(frags, bucket)))[:total]
+        return self._split_rows(batch, out), bucket
+
+    def _op_verify_batch(self, batch):
+        aux = batch[0].aux
+        ids = np.concatenate([r.arrays["ids"] for r in batch], axis=0)
+        mu = np.concatenate([r.arrays["mu"] for r in batch], axis=0)
+        sigma = np.concatenate([r.arrays["sigma"] for r in batch],
+                               axis=0)
+        total = ids.shape[0]
+        bucket = bucket_rows(total)
+        num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
+        prog = self.programs.get(
+            ("verify_batch", batch[0].key, bucket),
+            lambda: (lambda i, u, s: self.audit.verify_batch(
+                i, num_blocks, idx, nu, u, s)))
+        out = np.asarray(prog(_pad_axis0(ids, bucket),
+                              _pad_axis0(mu, bucket),
+                              _pad_axis0(sigma, bucket)))[:total]
+        return self._split_rows(batch, out), bucket
+
+    def _op_verify_agg(self, batch):
+        import jax
+
+        from ..ops import podr2
+
+        aux = batch[0].aux
+        fb = bucket_rows(max(r.rows for r in batch))
+        rb = bucket_rows(len(batch))
+        ids = np.zeros((rb, fb, 2), dtype=np.uint32)
+        rs = np.zeros((rb, fb), dtype=np.uint32)
+        mu = np.zeros((rb,) + batch[0].arrays["mu"].shape, np.uint32)
+        sigma = np.zeros((rb,) + batch[0].arrays["sigma"].shape,
+                         np.uint32)
+        for i, r in enumerate(batch):
+            ids[i, :r.rows] = r.arrays["ids"]
+            rs[i, :r.rows] = r.arrays["r"]
+            mu[i] = r.arrays["mu"]
+            sigma[i] = r.arrays["sigma"]
+        num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
+        audit = self.audit
+
+        def build():
+            fn = jax.vmap(lambda i, rr, u, s: podr2.verify_aggregate(
+                audit.key, i, num_blocks, idx, nu, rr, u, s))
+
+            def run(i, rr, u, s):
+                with jax.default_device(audit.device):
+                    return fn(i, rr, u, s)
+            return run
+
+        prog = self.programs.get(("verify_agg", batch[0].key, fb, rb),
+                                 build)
+        out = np.asarray(prog(ids, rs, mu, sigma))
+        results = [bool(out[i]) for i in range(len(batch))]
+        return results, rb * fb
+
+    def _op_prove(self, batch):
+        import jax
+
+        from ..ops import podr2
+
+        aux = batch[0].aux
+        fb = bucket_rows(max(r.rows for r in batch))
+        rb = bucket_rows(len(batch))
+        nbytes = batch[0].arrays["fragments"].shape[1]
+        blocks, limbs = batch[0].arrays["tags"].shape[1:]
+        frags = np.zeros((rb, fb, nbytes), dtype=np.uint8)
+        tags = np.zeros((rb, fb, blocks, limbs), dtype=np.uint32)
+        rs = np.zeros((rb, fb), dtype=np.uint32)
+        for i, r in enumerate(batch):
+            frags[i, :r.rows] = r.arrays["fragments"]
+            tags[i, :r.rows] = r.arrays["tags"]
+            rs[i, :r.rows] = r.arrays["r"]
+        idx, nu, sectors = aux["idx"], aux["nu"], aux["sectors"]
+        audit = self.audit
+
+        def build():
+            fn = jax.vmap(lambda f, t, rr: podr2.prove_aggregate(
+                f, t, idx, nu, rr, sectors))
+
+            def run(f, t, rr):
+                with jax.default_device(audit.device):
+                    return fn(f, t, rr)
+            return run
+
+        prog = self.programs.get(("prove", batch[0].key, fb, rb), build)
+        mu, sigma = prog(frags, tags, rs)
+        mu = np.asarray(mu)
+        sigma = np.asarray(sigma)
+        results = [(mu[i], sigma[i]) for i in range(len(batch))]
+        return results, rb * fb
+
+
+def make_engine(k: int | None = None, m: int | None = None, *,
+                rs_backend: str = "cpu", strategy: str | None = None,
+                podr2_key=None, audit_backend: str = "cpu",
+                policy: AdmissionPolicy | None = None) -> SubmissionEngine:
+    """Build an engine over the two trait gates.
+
+    k/m select the ErasureCodec geometry (None = no codec: the engine
+    serves only audit classes); podr2_key enables the audit classes
+    (None = no AuditBackend: tag/prove/verify submits raise).
+    """
+    codec = None
+    if k is not None:
+        from ..ops import rs
+
+        codec = rs.make_codec(k, m, backend=rs_backend, strategy=strategy)
+    audit = None
+    if podr2_key is not None:
+        from ..ops import audit_backend as ab
+
+        audit = ab.make_audit_backend(podr2_key, audit_backend)
+    return SubmissionEngine(codec, audit, policy)

@@ -1,0 +1,99 @@
+"""Engine counters: queue depth, batch occupancy, pad waste, latency.
+
+The serving layer is only tunable if its behavior is visible — the
+reference threads a Prometheus registry through every subsystem
+(node/src/service.rs:109-151), and the engine exports through the same
+surface: ``node/metrics.py`` merges :meth:`EngineStats.metrics` into
+the ``/metrics`` exposition when a node has an engine attached, and
+the RPC debug endpoint ``cess_engineStats`` serves the raw snapshot.
+
+Everything here is updated under the engine lock by design (the
+batcher and submitters already hold it at every recording site), so
+the counters need no locking of their own.
+"""
+from __future__ import annotations
+
+import collections
+
+from . import policy
+
+LATENCY_WINDOW = 512     # per-class sliding window for percentiles
+
+
+class ClassStats:
+    __slots__ = ("submitted", "completed", "failed", "timeouts",
+                 "saturated", "batches", "batched_requests", "rows",
+                 "padded_rows", "latencies")
+
+    def __init__(self):
+        self.submitted = 0          # requests admitted to the queue
+        self.completed = 0          # futures resolved with a result
+        self.failed = 0             # futures resolved with an op error
+        self.timeouts = 0           # cancelled: deadline expired queued
+        self.saturated = 0          # rejected at submit: queue full
+        self.batches = 0            # device batches launched
+        self.batched_requests = 0   # requests across those batches
+        self.rows = 0               # real rows across those batches
+        self.padded_rows = 0        # pad rows added to reach buckets
+        self.latencies = collections.deque(maxlen=LATENCY_WINDOW)
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def occupancy(self) -> float:
+        """Mean requests coalesced per device batch."""
+        return self.batched_requests / self.batches if self.batches else 0.0
+
+    @property
+    def pad_waste(self) -> float:
+        """Fraction of device rows that were padding."""
+        total = self.rows + self.padded_rows
+        return self.padded_rows / total if total else 0.0
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1] over the sliding submit->resolve latency window."""
+        if not self.latencies:
+            return 0.0
+        xs = sorted(self.latencies)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+class EngineStats:
+    """One ClassStats per op class + engine-wide program-cache counts."""
+
+    def __init__(self):
+        self.classes = {c: ClassStats() for c in policy.CLASSES}
+        self.programs_built = 0     # program-cache misses (compiles)
+        self.programs_reused = 0    # program-cache hits
+
+    def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
+        """JSON-shaped dump for the RPC debug endpoint."""
+        depths = queue_depths or {}
+        out: dict = {"programs_built": self.programs_built,
+                     "programs_reused": self.programs_reused,
+                     "classes": {}}
+        for cls, st in self.classes.items():
+            out["classes"][cls] = {
+                "queue_depth": depths.get(cls, 0),
+                "submitted": st.submitted,
+                "completed": st.completed,
+                "failed": st.failed,
+                "timeouts": st.timeouts,
+                "saturated": st.saturated,
+                "batches": st.batches,
+                "batch_occupancy": round(st.occupancy, 4),
+                "pad_waste": round(st.pad_waste, 4),
+                "latency_p50": round(st.percentile(0.50), 6),
+                "latency_p99": round(st.percentile(0.99), 6),
+            }
+        return out
+
+    def metrics(self, queue_depths: dict[str, int] | None = None
+                ) -> dict[str, float]:
+        """Flat Prometheus-style gauges (merged by node/metrics.py)."""
+        snap = self.snapshot(queue_depths)
+        out = {"cess_engine_programs_built": snap["programs_built"],
+               "cess_engine_programs_reused": snap["programs_reused"]}
+        for cls, st in snap["classes"].items():
+            for name, val in st.items():
+                out[f"cess_engine_{cls}_{name}"] = val
+        return out

@@ -6,9 +6,22 @@ multi-chip path via __graft_entry__.dryrun_multichip).
 
 Note: this box's axon sitecustomize registers the TPU plugin and
 overrides JAX_PLATFORMS env at interpreter start, so env vars alone
-don't stick — the programmatic config update below does.
+don't stick — the programmatic config update below does. The
+``jax_num_cpu_devices`` option only exists on newer jax; older
+installs fall back to XLA_FLAGS, which the (lazy) CPU backend init
+reads later. The two knobs must NEVER both be set — newer jax
+rejects the combination — so the env fallback lives strictly inside
+the AttributeError branch.
 """
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:      # pre-0.5 jax: the XLA flag is the only way
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()

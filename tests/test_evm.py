@@ -1007,8 +1007,17 @@ def test_eth_block_receipts_and_tx_by_index():
     # cumulative gas accumulates across the block
     assert int(rcs[1]["cumulativeGasUsed"], 16) \
         == int(rcs[0]["gasUsed"], 16) + int(rcs[1]["gasUsed"], 16)
-    # pruned-out receipt state answers null, never a fabricated []
+    # a canonical in-retention block with NO signed extrinsics answers
+    # [] (the spec shape for an existing empty block), never null
+    node.try_author(2) and node.commit_proposal()
+    assert node.head().number == 2
+    assert srv.handle("eth_getBlockReceipts", ["0x2"]) == []
+    # pruned-out receipt state answers null, never a fabricated []:
+    # real pruning deletes the count key AND advances the pruned_to
+    # cursor past the block, which is what distinguishes "pruned" from
+    # "canonical but empty"
     node.runtime.state.delete("ethereum", "count", 1)
+    node.runtime.state.put("ethereum", "pruned_to", 2)
     assert srv.handle("eth_getBlockReceipts", ["0x1"]) is None
     tx0 = srv.handle("eth_getTransactionByBlockNumberAndIndex",
                      ["0x1", "0x0"])
@@ -1016,3 +1025,134 @@ def test_eth_block_receipts_and_tx_by_index():
     assert tx0["transactionIndex"] == "0x0"
     assert srv.handle("eth_getTransactionByBlockNumberAndIndex",
                       ["0x1", "0x9"]) is None
+
+
+def test_create_nonce_persists_after_init_revert(rt):
+    """Mainnet semantics: a CREATE whose init reverts still bumps the
+    creator's nonce in the PARENT frame — a retried create derives a
+    fresh address instead of deterministically reusing the old one."""
+    from cess_tpu.chain.evm import create_address
+
+    factory = rt.apply_extrinsic("dev", "evm.deploy", initcode(asm(
+        "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+        "CALLDATASIZE", 0,             # size, offset
+        0,                             # value
+        "CREATE",
+        0, "MSTORE", 32, 0, "RETURN")))
+    # init that reverts: the child overlay is discarded...
+    out = rt.apply_extrinsic("dev", "evm.call", factory,
+                             asm(0, 0, "REVERT"), 2_000_000)
+    assert int.from_bytes(out, "big") == 0          # create failed
+    # ...but the nonce bump persists in the parent world
+    assert rt.state.get("evm", "nonce", factory, default=0) == 1
+    # the retry lands at the nonce-1 address, NOT a reuse of nonce 0
+    child_runtime = asm(5, 0, "MSTORE", 32, 0, "RETURN")
+    out2 = rt.apply_extrinsic("dev", "evm.call", factory,
+                              initcode(child_runtime), 2_000_000)
+    addr = out2[12:32]
+    assert addr == create_address(factory, 1)
+    assert addr != create_address(factory, 0)
+    assert rt.evm.code_at(addr) == child_runtime
+    assert rt.state.get("evm", "nonce", factory, default=0) == 2
+
+
+def test_call_to_empty_runtime_code_is_value_transfer(rt):
+    """A contract whose init returned EMPTY runtime code is a plain
+    account (mainnet): calls to it are pure value transfers, so value
+    parked there stays reachable — previously evm.call raised
+    NoContract because code_at conflated b"" with 'no entry'."""
+    # init = STOP: returns no output -> empty runtime code stored
+    empty = rt.apply_extrinsic("dev", "evm.deploy", asm("STOP"))
+    assert rt.evm.code_at(empty) == b""
+    rt.apply_extrinsic("dev", "evm.deposit", 100)
+    out = rt.apply_extrinsic("dev", "evm.call", empty, b"", 100_000, 40)
+    assert out == b""
+    assert rt.evm.balance_of(empty) == 40
+    assert rt.evm.balance("dev") == 60
+    # eth_call / estimate agree: success with empty output, zero gas
+    assert rt.evm.query(empty, b"xyz") == b""
+    assert rt.evm.estimate(empty, b"") == 0
+    # a truly nonexistent code entry still refuses: None != b""
+    with pytest.raises(DispatchError, match="NoContract"):
+        rt.apply_extrinsic("dev", "evm.call", b"\x01" * 20, b"")
+
+
+def test_txloc_first_write_wins_on_replayed_extrinsic():
+    """A stale-nonce duplicate re-included by a later block author
+    must not re-point eth_getTransactionReceipt at its failed
+    dispatch: the original inclusion's (block, idx) stays canonical."""
+    import hashlib as _hl
+
+    from cess_tpu import codec as _codec
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.crypto import ed25519
+
+    rt = Runtime(RuntimeConfig(era_blocks=10 ** 6))
+    rt.fund("dev", 1_000 * D)
+    key = ed25519.SigningKey.generate(b"dev-dup")
+    rt.init_block()
+    xt = sign_extrinsic(key, rt.genesis_hash(), "dev",
+                        rt.system.nonce("dev"), "balances.transfer",
+                        ("bob", 1 * D), None)
+    h = _hl.sha256(_codec.encode(xt)).digest()
+    rt.apply_in_block(xt)
+    blk1 = rt.state.block
+    assert rt.state.get("ethereum", "txloc", h) == (blk1, 0)
+    assert rt.state.get("ethereum", "receipt", blk1, 0)[3] == 1
+    # the duplicate: same bytes, later block, fails with BadNonce
+    rt.init_block()
+    blk2 = rt.state.block
+    failed_before = len(rt.state.events_of("system", "ExtrinsicFailed"))
+    rt.apply_in_block(xt)
+    assert len(rt.state.events_of("system", "ExtrinsicFailed")) \
+        == failed_before + 1
+    # first write wins: location AND receipt untouched by the replay
+    assert rt.state.get("ethereum", "txloc", h) == (blk1, 0)
+    assert rt.state.get("ethereum", "receipt", blk2, 0) is None
+    assert rt.state.get("ethereum", "count", blk2, default=0) == 0
+
+
+def test_txloc_failed_first_inclusion_superseded_by_success():
+    """The dual of first-write-wins: a tx whose FIRST inclusion failed
+    without consuming the nonce (unfunded signer) and is later
+    re-included successfully must get its receipt re-pointed at the
+    success — not forever report failure for a transfer that ran."""
+    import hashlib as _hl
+
+    from cess_tpu import codec as _codec
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.crypto import ed25519
+
+    rt = Runtime(RuntimeConfig(era_blocks=10 ** 6))
+    key = ed25519.SigningKey.generate(b"dev-retry")
+    rt.init_block()
+    xt = sign_extrinsic(key, rt.genesis_hash(), "dev", 0,
+                        "balances.transfer", ("bob", 1 * D), None)
+    h = _hl.sha256(_codec.encode(xt)).digest()
+    rt.apply_in_block(xt)           # unfunded: CannotPayFee, nonce kept
+    blk1 = rt.state.block
+    assert rt.state.get("ethereum", "txloc", h) == (blk1, 0)
+    assert rt.state.get("ethereum", "receipt", blk1, 0)[3] == 0
+    assert rt.system.nonce("dev") == 0
+    rt.fund("dev", 1_000 * D)
+    rt.init_block()
+    blk2 = rt.state.block
+    rt.apply_in_block(xt)           # re-included: succeeds this time
+    assert rt.balances.free("bob") == 1 * D
+    # the mapping moved to the success; the old block keeps its honest
+    # failed-attempt receipt row
+    assert rt.state.get("ethereum", "txloc", h) == (blk2, 0)
+    assert rt.state.get("ethereum", "receipt", blk2, 0)[3] == 1
+    assert rt.state.get("ethereum", "receipt", blk1, 0)[3] == 0
+    # and a FAILED replay after the success never re-points it back
+    rt.init_block()
+    rt.apply_in_block(xt)           # stale nonce now: fails
+    assert rt.state.get("ethereum", "txloc", h) == (blk2, 0)
+    # pruning the block holding the SUPERSEDED failed receipt must not
+    # destroy the mapping to the still-retained successful receipt
+    rt._prune_eth_block(blk1)
+    assert rt.state.get("ethereum", "receipt", blk1, 0) is None
+    assert rt.state.get("ethereum", "txloc", h) == (blk2, 0)
+    # pruning the success's own block finally drops the mapping
+    rt._prune_eth_block(blk2)
+    assert rt.state.get("ethereum", "txloc", h) is None

@@ -1,0 +1,529 @@
+"""Device submission engine (cess_tpu/serve): batch coalescing
+determinism, bucket padding, priority, backpressure/timeout contracts,
+and the stats surface through node/metrics.py + RPC.
+
+The hard invariant throughout: engine-mediated results are
+BIT-IDENTICAL to the direct ErasureCodec / AuditBackend calls —
+the engine decides WHEN and HOW BATCHED device work runs, never what
+it computes (protocol determinism, like the codec gate itself).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from cess_tpu.ops import podr2, rs
+from cess_tpu.serve import (AdmissionPolicy, EngineClosed,
+                            EngineSaturated, EngineTimeout, make_engine)
+
+K, M = 2, 1
+FRAG = 1024               # bytes per fragment -> 2 PoDR2 blocks
+
+
+@pytest.fixture(scope="module")
+def pkey():
+    return podr2.Podr2Key.generate(21)
+
+
+@pytest.fixture()
+def engine(pkey):
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.005))
+    yield eng
+    eng.close()
+
+
+def rnd(shape, seed=0, dtype=np.uint8):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, np.iinfo(dtype).max, shape, dtype=dtype)
+
+
+# -- determinism: engine == direct, per op class ---------------------------
+
+def test_encode_bit_identical_and_padded(engine):
+    codec = rs.make_codec(K, M, backend="cpu")
+    for b, seed in ((1, 1), (3, 2), (5, 3)):       # odd sizes force pads
+        data = rnd((b, K, 256), seed)
+        assert np.array_equal(engine.encode(data), codec.encode(data))
+    # 2-D submit round-trips without a batch axis
+    one = rnd((K, 256), 9)
+    out = engine.encode(one)
+    assert out.shape == (K + M, 256)
+    assert np.array_equal(out, codec.encode(one[None])[0])
+    st = engine.stats_snapshot()["classes"]["encode"]
+    assert st["pad_waste"] > 0          # 3- and 5-row batches padded
+
+
+def test_reconstruct_and_decode_match_direct(engine):
+    codec = rs.make_codec(K, M, backend="cpu")
+    data = rnd((4, K, 512), 5)
+    coded = codec.encode(data)
+    # drop row 0: survivors are rows (1, 2)
+    surv = coded[:, [1, 2]]
+    rec = engine.reconstruct(surv, (1, 2), (0,))
+    assert np.array_equal(rec, codec.reconstruct(surv, (1, 2), (0,)))
+    assert np.array_equal(rec[:, 0], coded[:, 0])
+    dec = engine.decode_data(surv, (1, 2))
+    assert np.array_equal(dec, data)
+
+
+def test_tag_prove_verify_bit_identical(engine, pkey):
+    frags = rnd((5, FRAG), 7)
+    hashes = [bytes([i]) * 32 for i in range(5)]
+    ids = np.stack([podr2.fragment_id_from_hash(h) for h in hashes])
+    tags = engine.tag_fragments(ids, frags)
+    direct = np.asarray(podr2.tag_fragments(pkey, ids, frags))
+    assert np.array_equal(tags, direct)
+    blocks = tags.shape[1]
+    idx, nu = podr2.gen_challenge(b"round-1", blocks)
+    r = np.asarray(podr2.aggregate_coeffs(b"round-1", ids))
+    mu, sigma = engine.prove_aggregate(frags, tags, idx, nu, r)
+    dmu, dsigma = podr2.prove_aggregate(frags, tags, idx, nu, r)
+    assert np.array_equal(mu, np.asarray(dmu))
+    assert np.array_equal(sigma, np.asarray(dsigma))
+    assert engine.verify_aggregate(ids, blocks, idx, nu, r, mu, sigma)
+    # per-fragment checks coalesce along F and agree with the direct op
+    mu_b, sigma_b = podr2.prove_batch(frags, tags, idx, nu)
+    ok = engine.verify_batch(ids, blocks, idx, nu, np.asarray(mu_b),
+                             np.asarray(sigma_b))
+    dok = np.asarray(podr2.verify_batch(pkey, ids, blocks, idx, nu,
+                                        mu_b, sigma_b))
+    assert np.array_equal(ok, dok) and ok.all()
+
+
+def test_verify_aggregate_coalesces_ragged_missions(pkey):
+    """Missions with DIFFERENT owed-set sizes coalesce into one
+    F-padded vmap batch; verdicts match the direct per-mission calls,
+    including a tampered proof rejected inside the same batch."""
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.25))
+    try:
+        blocks = FRAG // podr2.BLOCK_BYTES
+        idx, nu = podr2.gen_challenge(b"round-2", blocks)
+        missions = []
+        for i, f in enumerate((2, 3, 5)):        # ragged owed sets
+            frags = rnd((f, FRAG), 30 + i)
+            hashes = [bytes([40 + i, j]) * 16 for j in range(f)]
+            ids = np.stack([podr2.fragment_id_from_hash(h)
+                            for h in hashes])
+            tags = np.asarray(podr2.tag_fragments(pkey, ids, frags))
+            r = np.asarray(podr2.aggregate_coeffs(b"round-2", ids))
+            mu, sigma = podr2.prove_aggregate(frags, tags, idx, nu, r)
+            mu, sigma = np.asarray(mu), np.asarray(sigma)
+            if i == 1:                           # tamper one mission
+                sigma = (sigma + 1) % (2 ** 31 - 1)
+            missions.append((ids, r, mu, sigma))
+        # submit back-to-back (inputs prepared above, so all three
+        # land in the queue within the coalescing window)
+        futs = [eng.submit_verify_aggregate(ids, blocks, idx, nu, r,
+                                            mu, sigma)
+                for ids, r, mu, sigma in missions]
+        want = [bool(np.asarray(podr2.verify_aggregate(
+            pkey, ids, blocks, idx, nu, r, mu, sigma)))
+            for ids, r, mu, sigma in missions]
+        got = [bool(f.result(timeout=30)) for f in futs]
+        assert got == want == [True, False, True]
+        st = eng.stats_snapshot()["classes"]["verify"]
+        assert st["batch_occupancy"] > 1        # they really coalesced
+    finally:
+        eng.close()
+
+
+# -- pipeline + offchain wiring --------------------------------------------
+
+def test_pipeline_engine_matches_direct(pkey):
+    from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+
+    cfg = PipelineConfig(k=K, m=M, segment_size=K * FRAG)
+    direct = StoragePipeline(cfg, podr2_key=pkey)
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        piped = StoragePipeline(cfg, podr2_key=pkey, engine=eng)
+        segs = rnd((3, K * FRAG), 11)
+        a = np.asarray(direct.encode_step(segs))
+        b = np.asarray(piped.encode_step(segs))
+        assert np.array_equal(a, b)
+        ids = rnd((3, K + M, 2), 12, dtype=np.uint32)
+        ta = np.asarray(direct.tag_step(a, ids))
+        tb = np.asarray(piped.tag_step(b, ids))
+        assert np.array_equal(ta, tb)
+    finally:
+        eng.close()
+    # a mismatched audit key is refused loudly (silent tag divergence)
+    other = podr2.Podr2Key.generate(99)
+    eng2 = make_engine(K, M, podr2_key=other,
+                       policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        with pytest.raises(ValueError, match="key"):
+            StoragePipeline(cfg, podr2_key=pkey, engine=eng2)
+    finally:
+        eng2.close()
+
+
+def test_build_proof_engine_path_identical(engine, pkey):
+    from cess_tpu.node.offchain import build_proof
+
+    frags = rnd((4, FRAG), 17)
+    hashes = [bytes([60 + i]) * 32 for i in range(4)]
+    ids = np.stack([podr2.fragment_id_from_hash(h) for h in hashes])
+    tags = np.asarray(podr2.tag_fragments(pkey, ids, frags))
+    store = {h: frags[i].tobytes() for i, h in enumerate(hashes)}
+    tagmap = {h: tags[i] for i, h in enumerate(hashes)}
+    direct = build_proof(b"round-3", hashes, store, tagmap,
+                         limbs=pkey.limbs)
+    via_engine = build_proof(b"round-3", hashes, store, tagmap,
+                             limbs=pkey.limbs, engine=engine)
+    assert direct == via_engine       # identical wire bytes
+
+
+def test_tee_agent_verify_engine_path(engine, pkey):
+    """TeeAgent._verify routes through the engine's verify class when
+    one is configured, with verdicts identical to the direct path —
+    including malformed-blob rejection (never an exception)."""
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.offchain import TeeAgent, build_proof
+
+    node = Node(dev_spec(), "tee-host", {})
+    blocks = FRAG // podr2.BLOCK_BYTES
+    direct_tee = TeeAgent(node, "alice", pkey, blocks)
+    engine_tee = TeeAgent(node, "alice", pkey, blocks, engine=engine)
+    frags = rnd((3, FRAG), 55)
+    hashes = [bytes([70 + i]) * 32 for i in range(3)]
+    ids = np.stack([podr2.fragment_id_from_hash(h) for h in hashes])
+    tags = np.asarray(podr2.tag_fragments(pkey, ids, frags))
+    store = {h: frags[i].tobytes() for i, h in enumerate(hashes)}
+    tagmap = {h: tags[i] for i, h in enumerate(hashes)}
+    seed = b"round-5"
+    blob = build_proof(seed, hashes, store, tagmap, limbs=pkey.limbs)
+    idx, nu = podr2.gen_challenge(seed, blocks)
+    for owed in (hashes, hashes[:2]):       # honest + wrong owed set
+        assert engine_tee._verify(blob, owed, seed, idx, nu) \
+            == direct_tee._verify(blob, owed, seed, idx, nu)
+    assert engine_tee._verify(blob, hashes, seed, idx, nu) is True
+    assert engine_tee._verify(b"garbage", hashes, seed, idx, nu) is False
+    # a mismatched engine audit key is refused at construction
+    other = make_engine(K, M, podr2_key=podr2.Podr2Key.generate(98),
+                        policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        with pytest.raises(ValueError, match="key"):
+            TeeAgent(node, "alice", pkey, blocks, engine=other)
+    finally:
+        other.close()
+
+
+# -- contention: coalescing + priority --------------------------------------
+
+def test_concurrent_submitters_coalesce(pkey):
+    """>= 8 concurrent submitters (the acceptance-criteria contention
+    shape): their requests coalesce into shared device batches (mean
+    occupancy > 1) and every result is bit-identical to direct."""
+    codec = rs.make_codec(K, M, backend="cpu")
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.3))
+    n_threads = 8
+    datas = [rnd((2, K, 256), 100 + i) for i in range(n_threads)]
+    outs: list = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def submit(i):
+        barrier.wait()
+        outs[i] = eng.encode(datas[i], timeout=30)
+
+    try:
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        for i in range(n_threads):
+            assert np.array_equal(outs[i], codec.encode(datas[i])), i
+        st = eng.stats_snapshot()["classes"]["encode"]
+        assert st["submitted"] == st["completed"] == n_threads
+        assert st["batch_occupancy"] > 1, st
+    finally:
+        eng.close()
+
+
+def test_verify_preempts_queued_encode(pkey):
+    """Per-class priority: once a drain triggers, the verify class
+    goes to the device before bulk encode that queued EARLIER —
+    challenge verification preempts upload work (policy.py)."""
+    import time
+
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.4))
+    order: list[str] = []
+    real_encode, real_verify = eng._op_encode, eng._op_verify_batch
+    eng._op_encode = lambda b: (order.append("encode"),
+                                real_encode(b))[1]
+    eng._op_verify_batch = lambda b: (order.append("verify"),
+                                      real_verify(b))[1]
+    try:
+        f_enc = eng.submit_encode(rnd((1, K, 256), 1))
+        time.sleep(0.05)          # verify arrives LATER...
+        blocks = FRAG // podr2.BLOCK_BYTES
+        idx, nu = podr2.gen_challenge(b"round-4", blocks)
+        f_ver = eng.submit_verify_batch(
+            np.zeros((1, 2), np.uint32), blocks, idx, nu,
+            np.zeros((1, podr2.SECTORS), np.uint32),
+            np.zeros((1, podr2.LIMBS), np.uint32))
+        f_ver.result(timeout=30)
+        f_enc.result(timeout=30)
+        assert order == ["verify", "encode"]     # ...but runs FIRST
+    finally:
+        eng.close()
+
+
+# -- backpressure / timeout / shutdown contracts ----------------------------
+
+def test_saturation_is_explicit(pkey):
+    eng = make_engine(K, M, policy=AdmissionPolicy(
+        queue_cap=2, max_delay=30.0))
+    try:
+        data = rnd((1, K, 64), 3)
+        eng.submit_encode(data)
+        eng.submit_encode(data)
+        with pytest.raises(EngineSaturated):
+            eng.submit_encode(data)
+        st = eng.stats_snapshot()["classes"]["encode"]
+        assert st["saturated"] == 1 and st["queue_depth"] == 2
+    finally:
+        eng.close()
+
+
+def test_deadline_expiry_cancels(pkey):
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=30.0))
+    try:
+        fut = eng.submit_encode(rnd((1, K, 64), 4), timeout=0.05)
+        with pytest.raises(EngineTimeout):
+            fut.result(timeout=10)
+        st = eng.stats_snapshot()["classes"]["encode"]
+        assert st["timeouts"] == 1 and st["completed"] == 0
+    finally:
+        eng.close()
+
+
+def test_deadline_expiry_crosses_classes(pkey):
+    """An expired request in a LOW-priority class cancels promptly
+    even while a higher-priority class holds queued (untriggered)
+    work — expiry is a queue sweep, not a drain side-effect."""
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=30.0))
+    try:
+        blocks = FRAG // podr2.BLOCK_BYTES
+        idx, nu = podr2.gen_challenge(b"round-6", blocks)
+        f_ver = eng.submit_verify_batch(        # higher class, queued
+            np.zeros((1, 2), np.uint32), blocks, idx, nu,
+            np.zeros((1, podr2.SECTORS), np.uint32),
+            np.zeros((1, podr2.LIMBS), np.uint32))
+        f_enc = eng.submit_encode(rnd((1, K, 64), 7), timeout=0.05)
+        with pytest.raises(EngineTimeout):
+            f_enc.result(timeout=10)
+        st = eng.stats_snapshot()["classes"]
+        assert st["encode"]["timeouts"] == 1
+        # the verify request was NOT force-drained by the dead encode
+        # (no spurious occupancy-1 batches); it completes on close
+        eng.close()
+        assert f_ver.result(timeout=10).shape == (1,)
+    finally:
+        eng.close()
+
+
+def test_stacked_ops_cap_pad_spread(pkey):
+    """One huge prove request must not drag tiny same-round peers
+    into its row bucket: requests whose buckets differ more than
+    PAD_SPREAD split into separate batches."""
+    eng = make_engine(K, M, podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.25,
+                                             max_batch_rows=512))
+    try:
+        blocks = FRAG // podr2.BLOCK_BYTES
+        idx, nu = podr2.gen_challenge(b"round-7", blocks)
+        sets = []
+        for i, f in enumerate((64, 1, 1)):       # 64-row + two tiny
+            frags = rnd((f, FRAG), 80 + i)
+            ids = np.stack([podr2.fragment_id_from_hash(
+                bytes([90 + i, j % 256]) * 16) for j in range(f)])
+            tags = np.asarray(podr2.tag_fragments(pkey, ids, frags))
+            r = np.asarray(podr2.aggregate_coeffs(b"round-7", ids))
+            sets.append((frags, tags, r))
+        futs = [eng.submit_prove_aggregate(f, t, idx, nu, r)
+                for f, t, r in sets]
+        for (f, t, r), fut in zip(sets, futs):
+            mu, sigma = fut.result(timeout=60)
+            dmu, dsigma = podr2.prove_aggregate(f, t, idx, nu, r)
+            assert np.array_equal(mu, np.asarray(dmu))
+            assert np.array_equal(sigma, np.asarray(dsigma))
+        st = eng.stats_snapshot()["classes"]["prove"]
+        assert st["batches"] == 2        # big solo, two tiny together
+    finally:
+        eng.close()
+
+
+def test_closed_engine_refuses(pkey):
+    eng = make_engine(K, M)
+    eng.close()
+    with pytest.raises(EngineClosed):
+        eng.submit_encode(rnd((1, K, 64), 5))
+
+
+def test_close_drains_pending(pkey):
+    """close() is graceful: already-queued work completes."""
+    codec = rs.make_codec(K, M, backend="cpu")
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=30.0))
+    data = rnd((2, K, 64), 6)
+    fut = eng.submit_encode(data)
+    eng.close()
+    assert np.array_equal(fut.result(timeout=10), codec.encode(data))
+
+
+def test_flush_waits_for_quiescence(pkey):
+    """flush() returns only once every queued request has resolved
+    (including in-flight batches), and respects its own timeout."""
+    import time
+
+    codec = rs.make_codec(K, M, backend="cpu")
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=10.0))
+    real = eng._op_encode
+    eng._op_encode = lambda b: (time.sleep(0.3), real(b))[1]
+    try:
+        datas = [rnd((1, K, 64), s) for s in (1, 2)]
+        futs = [eng.submit_encode(d) for d in datas]
+        assert eng.flush(timeout=0.01) is False     # still working
+        assert eng.flush(timeout=30) is True
+        for f, d in zip(futs, datas):
+            assert f.done()
+            assert np.array_equal(f.result(), codec.encode(d))
+    finally:
+        eng.close()
+
+
+def test_close_timeout_rejects_still_queued(pkey):
+    """A close() whose drain outlives its timeout rejects every
+    still-queued future with EngineClosed — no caller hangs forever
+    on a future that will never fire."""
+    import time
+
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=30.0))
+    real = eng._op_encode
+    eng._op_encode = lambda b: (time.sleep(1.5), real(b))[1]
+    # different shapes -> two batches: the first goes in flight (and
+    # sleeps), the second is still queued when close() gives up
+    f1 = eng.submit_encode(rnd((1, K, 64), 1))
+    f2 = eng.submit_encode(rnd((1, K, 128), 2))
+    time.sleep(0.3)                     # let batch 1 enter the runner
+    eng.close(timeout=0.1)
+    with pytest.raises(EngineClosed):
+        f2.result(timeout=10)
+    # the in-flight batch still resolves (process is alive)
+    assert f1.result(timeout=10).shape == (1, K + M, 64)
+
+
+def test_miner_agent_rejects_mismatched_engine_geometry(pkey):
+    from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.offchain import MinerAgent
+
+    node = Node(dev_spec(), "mm", {})
+    pipe = StoragePipeline(PipelineConfig(k=K, m=M,
+                                          segment_size=K * FRAG),
+                           podr2_key=pkey)
+    other = make_engine(4, 8, policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        with pytest.raises(ValueError, match="RS"):
+            MinerAgent(node, "m1", [], pipe, engine=other)
+    finally:
+        other.close()
+
+
+def test_program_cache_lru_bounded():
+    from cess_tpu.serve.buckets import ProgramCache
+
+    cache = ProgramCache(capacity=3)
+    for i in range(5):
+        cache.get(("op", i), lambda i=i: (lambda: i))
+    assert len(cache) == 3               # oldest two evicted
+    # hot keys survive: touch ("op", 2) then insert -> 3 goes, 2 stays
+    cache.get(("op", 2), lambda: (lambda: None))
+    cache.get(("op", 9), lambda: (lambda: None))
+    assert len(cache) == 3
+    built = []
+    cache.get(("op", 2), lambda: built.append(1))
+    assert not built                     # still cached
+
+
+# -- buckets + program cache -------------------------------------------------
+
+def test_bucket_padding_and_program_reuse(pkey):
+    from cess_tpu.serve.buckets import bucket_rows
+
+    assert [bucket_rows(n) for n in (1, 2, 3, 5, 9)] == [1, 2, 4, 8, 16]
+    # even a request past the row budget stays on the power-of-two
+    # grid (bounded program count beats exact-size one-off compiles)
+    assert bucket_rows(600) == 1024
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        codec = rs.make_codec(K, M, backend="cpu")
+        for seed in (1, 2, 3):
+            data = rnd((3, K, 128), seed)   # same bucket every time
+            assert np.array_equal(eng.encode(data), codec.encode(data))
+        snap = eng.stats_snapshot()
+        assert snap["programs_built"] == 1
+        assert snap["programs_reused"] == 2
+    finally:
+        eng.close()
+
+
+def test_mixed_shapes_do_not_cross_coalesce(pkey):
+    """Requests with different geometry keys never share a batch but
+    all complete correctly (the drain splits by key)."""
+    codec = rs.make_codec(K, M, backend="cpu")
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.2))
+    try:
+        a, b = rnd((2, K, 128), 1), rnd((2, K, 256), 2)
+        fa, fb = eng.submit_encode(a), eng.submit_encode(b)
+        assert np.array_equal(fa.result(timeout=30), codec.encode(a))
+        assert np.array_equal(fb.result(timeout=30), codec.encode(b))
+        assert eng.stats_snapshot()["classes"]["encode"]["batches"] == 2
+    finally:
+        eng.close()
+
+
+# -- observability surface ---------------------------------------------------
+
+def test_engine_stats_via_node_metrics_and_rpc(pkey):
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.metrics import collect, render_metrics
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.rpc import RpcServer
+
+    node = Node(dev_spec(), "eng-node",
+                {"alice": dev_spec().session_key("alice")})
+    srv = RpcServer(node, port=0)
+    try:
+        # no engine attached: RPC answers null, metrics stay clean
+        assert srv.handle("cess_engineStats", []) is None
+        assert not any(k.startswith("cess_engine_") for k in collect(node))
+        eng = make_engine(K, M, podr2_key=pkey,
+                          policy=AdmissionPolicy(max_delay=0.005))
+        node.engine = eng
+        try:
+            eng.encode(rnd((2, K, 128), 8))
+            m = collect(node)
+            assert m["cess_engine_encode_completed"] == 1
+            assert m["cess_engine_encode_batches"] == 1
+            assert "cess_engine_verify_queue_depth" in m
+            text = render_metrics(node)
+            assert "cess_engine_encode_batch_occupancy" in text
+            snap = srv.handle("cess_engineStats", [])
+            assert snap["classes"]["encode"]["completed"] == 1
+            assert set(snap["classes"]) \
+                == {"verify", "prove", "tag", "repair", "encode"}
+        finally:
+            eng.close()
+    finally:
+        srv.httpd.server_close()
