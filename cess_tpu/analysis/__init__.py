@@ -1,0 +1,23 @@
+"""cesslint: AST-based static analysis for the cess_tpu codebase.
+
+Three rule families over one shared parse (core.py):
+
+- trace-safety (trace_safety.py)      — ops/, serve/
+- lock-discipline (lock_discipline.py) — serve/, node/
+- consensus-determinism (determinism.py) — chain/
+
+CLI: ``python tools/cesslint.py [paths] [--rule ID] [--json]
+[--fix-hints] [--baseline FILE] [--write-baseline]``. Gate:
+tests/test_lint.py (tier-1). Suppress a single true positive with
+``# cesslint: disable=<rule-id>`` on (or directly above) the line;
+bulk legacy debt goes in tools/cesslint_baseline.json.
+"""
+from .core import (Finding, LintResult, ParsedModule, Rule, all_rules,
+                   apply_baseline, lint_modules, lint_paths, lint_source,
+                   load_baseline, write_baseline)
+
+__all__ = [
+    "Finding", "LintResult", "ParsedModule", "Rule", "all_rules",
+    "apply_baseline", "lint_modules", "lint_paths", "lint_source",
+    "load_baseline", "write_baseline",
+]

@@ -587,6 +587,9 @@ class Evm:
             base, used = self.state.get(PALLET, "fee_hist", n,
                                         default=(INITIAL_BASE_FEE, 0))
             fees.append(base)
+            # RPC read path only (eth_feeHistory's gasUsedRatio is a
+            # float by spec); never written back to consensus state
+            # cesslint: disable=consensus-float
             ratios.append(round(used / GAS_CAP, 6))
         # trailing entry = block newest+1's base fee (eth_feeHistory
         # shape): the recorded one for historical windows, the live one

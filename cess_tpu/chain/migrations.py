@@ -166,7 +166,7 @@ def stamp_genesis(state: State, version: int = SPEC_VERSION) -> None:
     state.put(SYSTEM, "spec_version", version)
     versions = current_versions() if version >= SPEC_VERSION \
         else {pallet: 1 for pallet in current_versions()}
-    for pallet, v in versions.items():
+    for pallet, v in sorted(versions.items()):
         state.put(SYSTEM, "storage_version", pallet, v)
 
 

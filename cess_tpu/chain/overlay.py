@@ -41,5 +41,6 @@ class ChainedOverlay:
         if self.parent is not None:
             self.parent.over.update(self.over)
         else:
-            for key, value in self.over.items():
+            for key, value in sorted(self.over.items(),
+                                     key=lambda kv: repr(kv[0])):
                 self.root_put(key, value)

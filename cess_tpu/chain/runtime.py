@@ -158,8 +158,9 @@ HAND_WEIGHTS = {
     "contracts.call": 20, "contracts.deploy": 20,
     "contracts.upload_code": 10,
 }
-CALL_WEIGHTS = {call: 10 * w for call, w in GENERATED_WEIGHTS.items()}
-for _call, _floor in HAND_WEIGHTS.items():
+CALL_WEIGHTS = {call: 10 * w
+                for call, w in sorted(GENERATED_WEIGHTS.items())}
+for _call, _floor in sorted(HAND_WEIGHTS.items()):
     # floors, not overrides: a future measured weight above the hand
     # value must win, or heavy dispatches get silently undercharged
     CALL_WEIGHTS[_call] = max(CALL_WEIGHTS.get(_call, 0), _floor)

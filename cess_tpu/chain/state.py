@@ -123,6 +123,7 @@ class State:
         """Iterate (suffix, value) for all keys under a prefix, sorted
         (determinism: iteration order is part of consensus)."""
         n = len(prefix)
+        # cesslint: disable=consensus-unordered-iter — sorted below
         items = [(k[n:], v) for k, v in self.kv.items()
                  if len(k) > n and k[:n] == prefix]
         items.sort(key=lambda kv: repr(kv[0]))
@@ -172,6 +173,8 @@ class State:
             return
         self.event_history[:] = [e for e in self.event_history
                                  if e[0] < min_block]
+        # per-key filtering is order-independent and never feeds a hash
+        # cesslint: disable=consensus-unordered-iter
         for k, lst in self._event_index.items():
             if lst and lst[-1][0] >= min_block:
                 self._event_index[k] = [e for e in lst if e[0] < min_block]
@@ -225,6 +228,9 @@ class State:
         return self._root_acc.to_bytes(32, "little")
 
     def _fold_root(self) -> tuple[int, dict[tuple, int]]:
+        # the root is a commutative MULTISET sum (module docstring):
+        # iteration order provably cannot change it
+        # cesslint: disable=consensus-unordered-iter
         hashes = {k: self._entry_hash(k, v) for k, v in self.kv.items()}
         return sum(hashes.values()) % _ROOT_MOD, hashes
 

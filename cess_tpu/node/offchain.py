@@ -340,9 +340,16 @@ class Proof:
     owed fragment with PRF coefficients (podr2.aggregate_coeffs). The
     chain sees only the codec-encoded bytes and caps the REAL wire
     size at SIGMA_MAX (runtime/src/lib.rs:992) — ~1.06 KiB here,
-    constant in the number of fragments."""
+    constant in the number of fragments.
+
+    Both fields are FIXED-WIDTH uint32 ndarrays. sigma used to be a
+    tuple of Python ints, whose varint encoding shrank whenever a limb
+    value happened to be small — so the wire size depended on the
+    (F-dependent) fold values and test_aggregate_proof_wire_size_constant
+    caught a 1-byte drift between F=1 and F=50. An ndarray encodes as
+    dtype + shape + raw bytes: byte-for-byte constant in F."""
     mu: np.ndarray              # [sectors] uint32
-    sigma: tuple[int, ...]      # F_p^limbs element (base-field limbs)
+    sigma: np.ndarray           # [limbs] uint32 F_p^limbs element
 
 
 def build_proof(seed: bytes, owed: list[bytes],
@@ -365,7 +372,7 @@ def build_proof(seed: bytes, owed: list[bytes],
     if not held:
         return codec.encode(Proof(
             mu=np.zeros((podr2.SECTORS,), np.uint32),
-            sigma=(0,) * limbs))
+            sigma=np.zeros((limbs,), np.uint32)))
     frags = np.stack([np.frombuffer(store[h], dtype=np.uint8)
                       for h in held])
     tag_arr = np.stack([tags[h] for h in held])
@@ -383,9 +390,9 @@ def build_proof(seed: bytes, owed: list[bytes],
         mu, sigma = podr2.prove_aggregate(jnp.asarray(frags),
                                           jnp.asarray(tag_arr), idx, nu,
                                           r)
-    sigma = np.asarray(sigma)
-    return codec.encode(Proof(mu=np.asarray(mu),
-                              sigma=tuple(int(v) for v in sigma)))
+    return codec.encode(Proof(
+        mu=np.ascontiguousarray(np.asarray(mu, dtype=np.uint32)),
+        sigma=np.ascontiguousarray(np.asarray(sigma, dtype=np.uint32))))
 
 
 class TeeAgent:
@@ -524,14 +531,13 @@ class TeeAgent:
         if not (isinstance(proof, Proof) and isinstance(proof.mu, np.ndarray)
                 and proof.mu.shape == (podr2.SECTORS,)
                 and proof.mu.dtype == np.uint32
-                and isinstance(proof.sigma, tuple)
-                and len(proof.sigma) == self.key.limbs
-                and all(isinstance(s, int) and 0 <= s < pf.P
-                        for s in proof.sigma)):
+                and isinstance(proof.sigma, np.ndarray)
+                and proof.sigma.shape == (self.key.limbs,)
+                and proof.sigma.dtype == np.uint32
+                and bool((proof.sigma < pf.P).all())):
             return False
         if not owed:
-            return proof.sigma == (0,) * self.key.limbs \
-                and not proof.mu.any()
+            return not proof.sigma.any() and not proof.mu.any()
         ids = np.stack([podr2.fragment_id_from_hash(h) for h in owed])
         r = podr2.aggregate_coeffs(seed, ids)
         # getattr: tests construct partial TeeAgents via __new__

@@ -1,0 +1,50 @@
+"""Version-guarded jax compatibility shims for the parallel layer.
+
+The repo targets a range of jax releases and two APIs it depends on
+moved across them:
+
+- ``shard_map``: ``jax.shard_map`` on new jax; on jax 0.4.x it lives
+  at ``jax.experimental.shard_map.shard_map`` (same signature for the
+  mesh/in_specs/out_specs kwargs we use).
+- CPU device-count override: new jax has the
+  ``jax_num_cpu_devices`` config; older releases only honor the
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` env var, and
+  ONLY if it is set before the (lazy) CPU backend initializes.
+
+Everything version-dependent that parallel/mesh.py,
+parallel/multihost.py, tests/conftest.py and the multiprocess test
+scripts need lives here, so a jax upgrade is a one-file audit.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def resolve_shard_map():
+    """The shard_map entry point for this jax version."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as exp_shard_map
+
+    return exp_shard_map
+
+
+shard_map = resolve_shard_map()
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Ask for ``n`` CPU devices. Must run before any jax call that
+    initializes the backend (jax.devices(), first trace, ...)."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # replace (not append) any inherited device-count flag: test
+        # subprocesses inherit the parent pytest's XLA_FLAGS and must
+        # still be able to ask for a different mesh size
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)

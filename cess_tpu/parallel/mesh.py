@@ -28,6 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.pipeline import StoragePipeline
 from ..ops import pfield as pf
 from ..ops import podr2
+from .compat import shard_map
 
 
 def make_mesh(devices=None, seg: int | None = None, byte: int = 1) -> Mesh:
@@ -108,7 +109,7 @@ def sharded_pipeline_step(pipeline: StoragePipeline, mesh: Mesh):
         return (shards, tags.reshape(b, rows, blocks_local, 2),
                 ok.reshape(b, rows))
 
-    mapped = jax.shard_map(
+    mapped = shard_map(        # compat: jax.shard_map moved across versions
         step,
         mesh=mesh,
         in_specs=(P("seg", None, "byte"), P("seg", None), P(), P()),

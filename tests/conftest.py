@@ -7,21 +7,21 @@ multi-chip path via __graft_entry__.dryrun_multichip).
 Note: this box's axon sitecustomize registers the TPU plugin and
 overrides JAX_PLATFORMS env at interpreter start, so env vars alone
 don't stick — the programmatic config update below does. The
-``jax_num_cpu_devices`` option only exists on newer jax; older
-installs fall back to XLA_FLAGS, which the (lazy) CPU backend init
-reads later. The two knobs must NEVER both be set — newer jax
-rejects the combination — so the env fallback lives strictly inside
-the AttributeError branch.
+version-guarded device-count shim (``jax_num_cpu_devices`` on newer
+jax, XLA_FLAGS before the lazy CPU backend init on older — never
+both; newer jax rejects the combination) lives in
+cess_tpu.parallel.compat so the subprocess-based multihost tests use
+the identical logic.
 """
 import os
+import sys
 
 import jax
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cess_tpu.parallel import compat  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except AttributeError:      # pre-0.5 jax: the XLA flag is the only way
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8").strip()
+compat.set_cpu_device_count(8)

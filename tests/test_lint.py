@@ -1,0 +1,616 @@
+"""tier-1 gate for cesslint (cess_tpu/analysis + tools/cesslint.py).
+
+Three proofs per analyzer family (ISSUE 2 acceptance):
+- the DIRTY fixture makes each rule fire at the seeded line;
+- the CLEAN twin — same shape, violation removed — stays silent
+  (zero false positives);
+- the real repo is clean: ``cess_tpu/`` has no unsuppressed,
+  unbaselined finding, and the whole scan stays under the ~10 s
+  budget (each file is parsed once and fanned out to every rule).
+
+Plus the suppression / baseline workflow and the CLI surface.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from cess_tpu import analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "cesslint_baseline.json")
+
+
+def lint(src: str, path: str) -> analysis.LintResult:
+    return analysis.lint_source(textwrap.dedent(src), path)
+
+
+def rules_at(result: analysis.LintResult) -> set[str]:
+    return {f.rule for f in result.findings}
+
+
+# ---------------------------------------------------------------------------
+# trace safety (ops/, serve/)
+# ---------------------------------------------------------------------------
+DIRTY_TRACE = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    COUNT = 0
+
+    @jax.jit
+    def bad(x, y):
+        global COUNT
+        COUNT += 1
+        print("tracing", x)
+        a = np.asarray(x)
+        b = float(y)
+        c = x.sum().item()
+        return jnp.asarray(a) + b + c
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def ok_static(x, n):
+        return x + int(n)      # n is static: NOT a tracer
+
+    def tables():
+        return (np.uint32(2 ** 40),
+                np.array([0, 255, 256], dtype=np.uint8))
+"""
+
+CLEAN_TRACE = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def good(x, y):
+        return jnp.sum(x) + y
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def good_static(x, n):
+        return x + int(n)
+
+    def host_side(x):
+        print("host", x)                     # not traced
+        return (np.asarray(x), np.uint8(255),
+                np.array([0, 255], dtype=np.uint8),
+                np.uint32((1 << 32) - 1))
+"""
+
+
+class TestTraceSafety:
+    def test_dirty_fixture_fires_every_rule(self):
+        r = lint(DIRTY_TRACE, "cess_tpu/ops/fixture.py")
+        assert rules_at(r) == {
+            "trace-global-mutation", "trace-print",
+            "trace-host-transfer", "trace-host-sync",
+            "dtype-overflow"}
+        # the two dtype hits: folded 2**40 and the list element 256
+        dtype = [f for f in r.findings if f.rule == "dtype-overflow"]
+        assert len(dtype) == 2
+        assert any("1099511627776" in f.message for f in dtype)
+        assert any("256" in f.message for f in dtype)
+
+    def test_clean_twin_is_silent(self):
+        r = lint(CLEAN_TRACE, "cess_tpu/ops/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_call_form_jit_respects_static_args(self):
+        src = """
+            import jax
+
+            def kern(x, n, mode):
+                return x * int(n) * float(mode)
+
+            kern_c = jax.jit(kern, static_argnums=(1,),
+                             static_argnames=("mode",))
+        """
+        r = lint(src, "cess_tpu/ops/fixture.py")
+        assert r.findings == []     # both static params excluded
+        src_traced = """
+            import jax
+
+            def kern(x, n):
+                return x * int(n)
+
+            kern_c = jax.jit(kern)
+        """
+        r = lint(src_traced, "cess_tpu/ops/fixture.py")
+        assert [f.rule for f in r.findings] == ["trace-host-sync"]
+
+    def test_trace_rules_do_not_apply_outside_device_code(self):
+        r = lint(DIRTY_TRACE, "cess_tpu/chain/fixture.py")
+        assert "trace-print" not in rules_at(r)
+
+
+# ---------------------------------------------------------------------------
+# lock discipline (serve/, node/)
+# ---------------------------------------------------------------------------
+# the serve-engine pattern, seeded with the exact bug class the rule
+# exists for: a _cond/_lock-guarded counter written lock-free elsewhere
+DIRTY_LOCK = """
+    import threading
+    import time
+
+    class MiniEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._inflight = 0
+            self._closed = False
+
+        def submit(self):
+            with self._cond:
+                self._inflight += 1
+                time.sleep(0.05)             # blocks peers out
+
+        def fast_path(self):
+            self._inflight -= 1              # guarded elsewhere!
+
+        def close(self):
+            with self._lock:
+                self._closed = True
+
+    class TwoLocks:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def forward(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def backward(self):
+            with self.b:
+                with self.a:
+                    pass
+"""
+
+CLEAN_LOCK = """
+    import threading
+    import time
+
+    class MiniEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._inflight = 0
+
+        def submit(self):
+            with self._cond:
+                self._inflight += 1
+                self._cond.wait(0.05)        # releases the lock: fine
+            time.sleep(0.05)                 # outside the lock: fine
+
+        def _drain_locked(self):
+            self._inflight -= 1              # *_locked convention
+
+        def drain(self):
+            with self._lock:
+                self._drain_locked()
+
+    class TwoLocks:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def forward(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def also_forward(self):
+            with self.a:
+                with self.b:
+                    pass
+"""
+
+
+class TestLockDiscipline:
+    def test_dirty_fixture_fires_every_rule(self):
+        r = lint(DIRTY_LOCK, "cess_tpu/serve/fixture.py")
+        assert rules_at(r) == {"lock-unguarded-write",
+                               "lock-blocking-call", "lock-order-cycle"}
+        unguarded = [f for f in r.findings
+                     if f.rule == "lock-unguarded-write"]
+        assert len(unguarded) == 1
+        assert "fast_path" in unguarded[0].message
+        assert "_inflight" in unguarded[0].message
+
+    def test_clean_twin_is_silent(self):
+        r = lint(CLEAN_LOCK, "cess_tpu/serve/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_inconsistent_guard_across_two_locks(self):
+        # written under _a in one method, _b in another: no common
+        # guard — a data race even though every write "holds a lock"
+        src = """
+            import threading
+
+            class M:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self.x = 0
+
+                def f(self):
+                    with self._a:
+                        self.x += 1
+
+                def f2(self):
+                    with self._a:
+                        self.x += 2
+
+                def g(self):
+                    with self._b:
+                        self.x -= 1
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        bad = [f for f in r.findings if f.rule == "lock-unguarded-write"]
+        assert len(bad) == 1
+        assert "`g`" in bad[0].message and "_b instead" in bad[0].message
+
+    def test_self_deadlock_and_wait_semantics(self):
+        src = """
+            import threading
+
+            class M:
+                def __init__(self):
+                    self.lk = threading.Lock()
+                    self.other = threading.Lock()
+                    self._cond = threading.Condition(self.lk)
+                    self._done = threading.Event()
+
+                def re_enter(self):
+                    with self.lk:
+                        with self.lk:            # self-deadlock
+                            pass
+
+                def event_wait(self):
+                    with self.lk:
+                        self._done.wait()        # Event.wait BLOCKS
+
+                def cross_wait(self):
+                    with self.other:
+                        with self._cond:
+                            # releases lk only; `other` stays held
+                            self._cond.wait()
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        by_rule = {}
+        for f in r.findings:
+            by_rule.setdefault(f.rule, []).append(f)
+        deadlock = [f for f in by_rule.get("lock-order-cycle", [])
+                    if "re-acquired" in f.message]
+        assert len(deadlock) == 1
+        waits = [f.message for f in by_rule.get("lock-blocking-call", [])]
+        assert any("_done.wait" in m for m in waits)
+        assert any("_cond.wait" in m for m in waits)
+
+    def test_rlock_reentry_and_own_cond_wait_are_fine(self):
+        src = """
+            import threading
+
+            class M:
+                def __init__(self):
+                    self.lk = threading.RLock()
+                    self._cond = threading.Condition(self.lk)
+
+                def re_enter(self):
+                    with self.lk:
+                        with self.lk:            # RLock: reentrant
+                            pass
+
+                def wait(self):
+                    with self._cond:
+                        self._cond.wait()        # releases its lock
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        assert r.findings == []
+
+    def test_dtype_overflow_applies_to_serve_too(self):
+        src = """
+            import numpy as np
+
+            PAD = np.uint8(300)
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        assert [f.rule for f in r.findings] == ["dtype-overflow"]
+
+    def test_serve_engine_is_clean(self):
+        """Satellite: the real 700-line lock-and-condvar core passes
+        its own analyzer with no unsuppressed findings."""
+        path = os.path.join(REPO, "cess_tpu", "serve", "engine.py")
+        r = analysis.lint_paths([path], root=REPO)
+        assert [f.format() for f in r.findings] == []
+
+    def test_node_locking_layers_are_clean(self):
+        paths = [os.path.join(REPO, "cess_tpu", "node", f)
+                 for f in ("net.py", "rpc.py", "dht.py")]
+        r = analysis.lint_paths(paths, root=REPO)
+        assert [f.format() for f in r.findings] == []
+
+
+# ---------------------------------------------------------------------------
+# consensus determinism (chain/)
+# ---------------------------------------------------------------------------
+DIRTY_DET = """
+    import hashlib
+    import random
+    import time
+
+    def apply_block(state, calls):
+        h = hashlib.sha256()
+        for k, v in state.items():           # dict order -> state root
+            h.update(k + v)
+        for who in {c.origin for c in calls}:   # set hash order
+            pass
+        stamp = time.time()
+        jitter = random.random()
+        fee = 3 / 2
+        weight = 0.5
+        return h.digest()
+"""
+
+CLEAN_DET = """
+    import hashlib
+
+    def apply_block(state, calls):
+        h = hashlib.sha256()
+        for k, v in sorted(state.items()):
+            h.update(k + v)
+        for who in sorted({c.origin for c in calls}):
+            pass
+        total = sum(c.fee for c in calls)    # order-insensitive fold
+        fee = 3 // 2
+        return h.digest()
+"""
+
+
+class TestDeterminism:
+    def test_dirty_fixture_fires_every_rule(self):
+        r = lint(DIRTY_DET, "cess_tpu/chain/fixture.py")
+        assert rules_at(r) == {"consensus-unordered-iter",
+                               "consensus-wallclock", "consensus-float"}
+        assert len([f for f in r.findings
+                    if f.rule == "consensus-unordered-iter"]) == 2
+        assert len([f for f in r.findings
+                    if f.rule == "consensus-float"]) == 2
+
+    def test_clean_twin_is_silent(self):
+        r = lint(CLEAN_DET, "cess_tpu/chain/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_bare_iteration_over_locally_built_containers(self):
+        src = """
+            def apply(items):
+                seen = set()
+                index = {}
+                for it in items:
+                    index[it.key] = it
+                for k in index:            # bare dict iteration
+                    pass
+                for s in seen:             # bare set iteration
+                    pass
+                ordered = sorted(index)
+                for k in ordered:          # fine
+                    pass
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert [f.rule for f in r.findings] == \
+            ["consensus-unordered-iter"] * 2
+
+    def test_reassigned_name_is_ambiguous_not_flagged(self):
+        src = """
+            def apply(flag, items):
+                d = {}
+                if flag:
+                    d = sorted(items)      # no longer a dict
+                for k in d:
+                    pass
+        """
+        assert lint(src, "cess_tpu/chain/fixture.py").findings == []
+
+    def test_chain_rules_do_not_apply_to_device_code(self):
+        r = lint(DIRTY_DET, "cess_tpu/ops/fixture.py")
+        assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline workflow
+# ---------------------------------------------------------------------------
+class TestSuppression:
+    def test_same_line_comment(self):
+        src = """
+            import time
+
+            def apply_block():
+                return time.time()  # cesslint: disable=consensus-wallclock
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert r.findings == []
+        assert [f.rule for f in r.suppressed] == ["consensus-wallclock"]
+
+    def test_own_line_comment_covers_next_line(self):
+        src = """
+            import time
+
+            def apply_block():
+                # justified: dev-only scaffolding
+                # cesslint: disable=consensus-wallclock
+                return time.time()
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert r.findings == []
+        assert len(r.suppressed) == 1
+
+    def test_trailing_prose_does_not_break_the_id(self):
+        src = """
+            import time
+
+            def f():
+                return time.time()  # cesslint: disable=consensus-wallclock — why not
+        """
+        assert lint(src, "cess_tpu/chain/fixture.py").findings == []
+
+    def test_wrong_rule_id_does_not_silence(self):
+        src = """
+            import time
+
+            def f():
+                return time.time()  # cesslint: disable=consensus-float
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert [f.rule for f in r.findings] == ["consensus-wallclock"]
+
+    def test_bare_disable_silences_all(self):
+        src = """
+            import time
+
+            def f():
+                return time.time() / 2  # cesslint: disable
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert r.findings == [] and len(r.suppressed) == 2
+
+    def test_unknown_directive_tail_does_not_blanket_suppress(self):
+        # a typo'd directive must not silently disable the gate
+        src = """
+            import time
+
+            def f():
+                return time.time()  # cesslint: disablegarbage
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert [f.rule for f in r.findings] == ["consensus-wallclock"]
+
+
+class TestBaseline:
+    def test_roundtrip_and_line_shift_tolerance(self, tmp_path):
+        r = lint(DIRTY_DET, "cess_tpu/chain/fixture.py")
+        assert r.findings
+        bl_file = str(tmp_path / "bl.json")
+        analysis.write_baseline(r.findings, bl_file)
+        baseline = analysis.load_baseline(bl_file)
+        # identical findings: all baselined
+        new, matched = analysis.apply_baseline(r.findings, baseline)
+        assert new == [] and len(matched) == len(r.findings)
+        # shifting every line (fingerprints are line-independent)
+        shifted = lint("\n\n\n" + textwrap.dedent(DIRTY_DET),
+                       "cess_tpu/chain/fixture.py")
+        new, _ = analysis.apply_baseline(shifted.findings, baseline)
+        assert new == []
+        # a NEW instance of a baselined pattern still surfaces
+        doubled = lint(textwrap.dedent(DIRTY_DET)
+                       + "\nBAD_WEIGHT = 0.25\n",
+                       "cess_tpu/chain/fixture.py")
+        new, _ = analysis.apply_baseline(doubled.findings, baseline)
+        assert [f.rule for f in new] == ["consensus-float"]
+        assert "0.25" in new[0].message
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert analysis.load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# ---------------------------------------------------------------------------
+# the repo gate + CLI
+# ---------------------------------------------------------------------------
+def test_repo_is_clean_and_fast():
+    """cess_tpu/ has zero unsuppressed, unbaselined findings — and the
+    full scan parses each file once, staying well inside ~10 s."""
+    t0 = time.monotonic()
+    r = analysis.lint_paths([os.path.join(REPO, "cess_tpu")], root=REPO)
+    elapsed = time.monotonic() - t0
+    assert r.errors == []
+    new, _ = analysis.apply_baseline(r.findings,
+                                     analysis.load_baseline(BASELINE))
+    assert [f.format() for f in new] == []
+    assert r.files > 50          # the scan actually covered the tree
+    assert elapsed < 10.0, f"repo scan took {elapsed:.1f}s"
+
+
+def _run_cli(*argv):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cesslint.py"),
+         *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    return proc.returncode, proc.stdout
+
+
+class TestCli:
+    def test_clean_repo_exits_zero(self):
+        code, out = _run_cli()
+        assert code == 0, out
+        assert "0 finding(s)" in out
+
+    def test_dirty_file_exits_nonzero_with_json_and_hints(self, tmp_path):
+        bad = tmp_path / "serve" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text(textwrap.dedent(DIRTY_LOCK))
+        code, out = _run_cli(str(bad), "--json", "--no-baseline")
+        assert code == 1
+        data = json.loads(out)
+        assert {f["rule"] for f in data["findings"]} == {
+            "lock-unguarded-write", "lock-blocking-call",
+            "lock-order-cycle"}
+        # --fix-hints prints the per-rule suggested edit
+        code, out = _run_cli(str(bad), "--fix-hints", "--no-baseline")
+        assert code == 1 and "hint:" in out
+
+    def test_rule_filter(self, tmp_path):
+        bad = tmp_path / "serve" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text(textwrap.dedent(DIRTY_LOCK))
+        code, out = _run_cli(str(bad), "--rule", "lock-blocking-call",
+                             "--json", "--no-baseline")
+        assert code == 1
+        data = json.loads(out)
+        assert {f["rule"] for f in data["findings"]} == {
+            "lock-blocking-call"}
+        code, _ = _run_cli("--rule", "no-such-rule")
+        assert code == 2
+
+    def test_unparseable_file_surfaces_as_error_not_silence(self, tmp_path):
+        # the scan must report (not skip) a broken file: the CLI
+        # returns 2 on errors and refuses --write-baseline from a
+        # partial scan, so baselines can never silently shrink
+        src_dir = tmp_path / "chain"
+        src_dir.mkdir()
+        (src_dir / "ok.py").write_text("import time\nT = time.time()\n")
+        (src_dir / "broken.py").write_text("def oops(:\n")
+        r = analysis.lint_paths([str(src_dir)], root=str(tmp_path))
+        assert len(r.errors) == 1 and "broken.py" in r.errors[0]
+        assert [f.rule for f in r.findings] == ["consensus-wallclock"]
+        code, _ = _run_cli(str(src_dir), "--no-baseline")
+        assert code == 2
+
+    def test_write_baseline_refuses_narrowed_scan(self, tmp_path):
+        # rewriting the baseline from a filtered run would silently
+        # drop every entry outside the filter
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "cesslint.py"),
+             "--write-baseline", "--rule", "consensus-float",
+             "--baseline", str(tmp_path / "bl.json")],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 2
+        assert "full default scan" in proc.stderr
+        assert not (tmp_path / "bl.json").exists()
+
+    def test_list_rules_names_every_family(self):
+        code, out = _run_cli("--list-rules")
+        assert code == 0
+        for rid in ("trace-host-sync", "dtype-overflow",
+                    "lock-unguarded-write", "lock-order-cycle",
+                    "consensus-unordered-iter", "consensus-wallclock",
+                    "consensus-float"):
+            assert rid in out

@@ -312,8 +312,12 @@ def test_offchain_proof_wire_respects_limb_width(limbs):
     assert TeeAgent._verify(tee, empty, [], b"limb-wire", idx, nu)
     # a WRONG-width sigma is a failed audit, not an exception
     wrong = codec.encode(Proof(mu=np.zeros((podr2.SECTORS,), np.uint32),
-                               sigma=(0,) * (limbs + 1)))
+                               sigma=np.zeros((limbs + 1,), np.uint32)))
     assert not TeeAgent._verify(tee, wrong, [], b"limb-wire", idx, nu)
+    # the legacy tuple-sigma wire shape is likewise a failed audit
+    legacy = codec.encode(Proof(mu=np.zeros((podr2.SECTORS,), np.uint32),
+                                sigma=(0,) * limbs))
+    assert not TeeAgent._verify(tee, legacy, [], b"limb-wire", idx, nu)
 
 
 def test_fillerless_miner_proof_width_limbs3():
