@@ -9,11 +9,24 @@ RS-encodes each into fragments; §3.3: TEE computes PoDR2 tags).
 Everything here is jit-able and batch-first: segments [B, segment_size]
 uint8 -> fragments [B, k+m, fragment_size] uint8 (+ per-fragment tags
 once the audit backend is wired in).
+
+The direct (engine-less) ``forward`` is ONE jitted device program —
+encode and tag fused, with the segment buffer DONATED on accelerator
+backends so XLA can reclaim it for the program's intermediates
+instead of holding staged input alongside the packed-element temps
+(the CPU backend skips donation — it cannot use an unaliased donated
+buffer and would warn per dispatch). Donation contract: on
+accelerators, callers must not reuse a device-resident ``segments``
+array after ``forward`` (host numpy inputs are unaffected — jit
+stages a fresh device copy and donates that). The double-buffered
+streaming driver (cess_tpu/serve/stream.py) is built on exactly this
+program.
 """
 from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from .. import constants
@@ -57,6 +70,7 @@ class StoragePipeline:
         self._parity = _MatrixApply(
             gf.cauchy_parity_matrix(config.k, config.m), strategy
         )
+        self._fused = None   # lazily-built fused encode+tag program
         # optional submission engine (cess_tpu/serve): when configured,
         # encode/tag submit through its batched queues so concurrent
         # callers coalesce into shared device batches. The direct
@@ -84,24 +98,27 @@ class StoragePipeline:
         fragments follow.
         """
         cfg = self.config
+        segments = jnp.asarray(segments)
         b = segments.shape[0]
         data = segments.reshape(b, cfg.k, cfg.fragment_size)
         if self.engine is not None and self.engine.codec is not None:
-            import numpy as np
-
-            return jnp.asarray(self.engine.encode(np.asarray(data)))
+            # zero-copy handoff: the engine accepts and returns
+            # jax.Array, so an already-device-resident batch never
+            # round-trips through the host on its way to the codec
+            return jnp.asarray(self.engine.encode(data))
         parity = self._parity(data)
         return jnp.concatenate([data, parity], axis=-2)
 
     def tag_step(self, fragments: jnp.ndarray,
                  fragment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
-        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks, 2].
+        """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks, limbs].
 
         fragment_ids: unique-per-key ids ([B, k+m] or [B, k+m, 2] hash
         word pairs, see podr2.fragment_id_from_hash). The arange default
         is for benches/demos ONLY — production must pass hash-derived
         ids, since id reuse across different data breaks unforgeability.
         """
+        fragments = jnp.asarray(fragments)
         b, rows, n = fragments.shape
         flat = fragments.reshape(b * rows, n)
         if fragment_ids is None:
@@ -113,19 +130,71 @@ class StoragePipeline:
         if self.engine is not None and self.engine.audit is not None \
                 and fragment_ids.ndim == 2:
             # engine tag class takes (lo, hi) id pairs; the arange
-            # bench default stays on the direct path
-            import numpy as np
-
-            tags = jnp.asarray(self.engine.tag_fragments(
-                np.asarray(fragment_ids), np.asarray(flat)))
+            # bench default stays on the direct path. Device arrays
+            # hand off zero-copy (engine returns jax.Array back).
+            tags = jnp.asarray(self.engine.tag_fragments(fragment_ids,
+                                                         flat))
         else:
             tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
         return tags.reshape(b, rows, *tags.shape[1:])
 
+    def fused_program(self):
+        """The fused encode+tag device program: ONE jitted call,
+        segments DONATED (see module doc), results bit-identical to
+        encode_step -> tag_step. jit caches per batch/id shape, so the
+        streaming driver reuses one compiled program per bucket.
+
+        Signature: (segments [B, segment_size] u8,
+                    fragment_ids [B*(k+m)] | [B, k+m] | [B, k+m, 2])
+                 -> {"fragments": [B, k+m, frag], "tags": [B, k+m, blocks, limbs]}
+        """
+        if self._fused is None:
+            cfg = self.config
+
+            def run(segments, fragment_ids):
+                b = segments.shape[0]
+                data = segments.reshape(b, cfg.k, cfg.fragment_size)
+                parity = self._parity(data)
+                shards = jnp.concatenate([data, parity], axis=-2)
+                rows = shards.shape[-2]
+                flat = shards.reshape(b * rows, cfg.fragment_size)
+                ids = fragment_ids.reshape(
+                    (b * rows, 2) if fragment_ids.ndim == 3
+                    else (b * rows,))
+                tags = podr2.tag_fragments(self.podr2_key, ids, flat)
+                return {"fragments": shards,
+                        "tags": tags.reshape(b, rows, *tags.shape[1:])}
+
+            # donate the staged segment batch: the buffer is dead the
+            # moment the program consumes it (the streaming driver
+            # stages a fresh one per batch), so XLA may reclaim it for
+            # the program's own intermediates instead of carrying
+            # 2 GiB of input alongside ~4x that of packed-element
+            # temps. The CPU backend cannot use an unaliased donation
+            # (no output matches the [B, seg] shape) and would warn on
+            # every dispatch, so the gate: accelerator-only.
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._fused = jax.jit(run, donate_argnums=donate)
+        return self._fused
+
     def forward(self, segments: jnp.ndarray,
                 fragment_ids: jnp.ndarray | None = None) -> dict[str, jnp.ndarray]:
         """The full pipeline step: encode + tag (the reference's
-        OSS-encode + TEE-tag off-chain compute as one device program)."""
-        shards = self.encode_step(segments)
-        tags = self.tag_step(shards, fragment_ids)
-        return {"fragments": shards, "tags": tags}
+        OSS-encode + TEE-tag off-chain compute as one device program).
+
+        Without an engine this is the FUSED path: one jitted call, no
+        intermediate materialization between encode and tag, segment
+        buffer donated. With an engine the two steps submit through its
+        queues (still zero-copy for device-resident inputs)."""
+        segments = jnp.asarray(segments)
+        if self.engine is not None:
+            shards = self.encode_step(segments)
+            tags = self.tag_step(shards, fragment_ids)
+            return {"fragments": shards, "tags": tags}
+        b = segments.shape[0]
+        if fragment_ids is None:
+            rows = self.config.k + self.config.m
+            fragment_ids = jnp.arange(b * rows, dtype=jnp.int32)
+        else:
+            fragment_ids = jnp.asarray(fragment_ids)
+        return self.fused_program()(segments, fragment_ids)

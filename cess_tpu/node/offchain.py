@@ -73,15 +73,19 @@ class OssGateway:
         frag_hashes = [
             [fragment_hash(b"pending")] * (cfg.k + cfg.m)
             for _ in range(n_segs)]
-        # hash fragments first (ids feed the tag PRF), then tag on device
-        out_frags = np.asarray(self.pipeline.encode_step(jnp.asarray(segments)))
+        # hash fragments first (ids feed the tag PRF), then tag on
+        # device. The device-resident fragments feed tag_step DIRECTLY
+        # (zero-copy engine handoff): the hashing fetch is the only
+        # D2H, and the fragment bytes are never re-uploaded for tagging
+        frags_dev = self.pipeline.encode_step(jnp.asarray(segments))
+        out_frags = np.asarray(frags_dev)
         ids = np.zeros((n_segs, cfg.k + cfg.m, 2), dtype=np.uint32)
         for i in range(n_segs):
             for j in range(cfg.k + cfg.m):
                 h = fragment_hash(out_frags[i, j].tobytes())
                 frag_hashes[i][j] = h
                 ids[i, j] = podr2.fragment_id_from_hash(h)
-        tags = np.asarray(self.pipeline.tag_step(jnp.asarray(out_frags),
+        tags = np.asarray(self.pipeline.tag_step(frags_dev,
                                                  jnp.asarray(ids)))
         for i in range(n_segs):
             for j in range(cfg.k + cfg.m):
@@ -272,6 +276,34 @@ class MinerAgent:
                               idle, service)
 
     # -- restoral servicing -------------------------------------------------------
+    def warm_restoral(self) -> None:
+        """Pre-compile + pre-stage the restoral market's reconstruct
+        programs — one per lost row, with the k lowest surviving rows
+        (exactly the survivor set try_repair assembles when every peer
+        holds its fragment) — so a claimed order pays kernel time, not
+        first-call compile + table staging. With an engine, the
+        engine's repair program cache is warmed under the keys its
+        batcher will hit; without one, the codec's AOT warm path is
+        used directly (no-op on the NumPy reference codec)."""
+        cfg = self.pipeline.config
+        rows = cfg.k + cfg.m
+        patterns = []
+        for row in range(rows):
+            present = tuple(j for j in range(rows) if j != row)[:cfg.k]
+            patterns.append((present, (row,)))
+        if self.engine is not None and self.engine.codec is not None:
+            self.engine.warm_repair(patterns, cfg.fragment_size)
+            return
+        from ..ops.rs import make_codec
+
+        # make_codec is lru_cached: this is the SAME instance
+        # try_repair resolves later, so the warm programs persist
+        codec_ = make_codec(cfg.k, cfg.m, backend="auto")
+        warm = getattr(codec_, "warm_reconstruct", None)
+        if warm is not None:
+            for present, missing in patterns:
+                warm(present, missing, (cfg.k, cfg.fragment_size))
+
     def try_repair(self, frag_hash: bytes, peers: list["MinerAgent"],
                    gateways: list[OssGateway] | None = None) -> bool:
         """Claim + repair a broken fragment via RS reconstruction from
@@ -339,8 +371,11 @@ class Proof:
     """The aggregated PoDR2 proof: ONE (mu, sigma) folded over every
     owed fragment with PRF coefficients (podr2.aggregate_coeffs). The
     chain sees only the codec-encoded bytes and caps the REAL wire
-    size at SIGMA_MAX (runtime/src/lib.rs:992) — ~1.06 KiB here,
-    constant in the number of fragments.
+    size at SIGMA_MAX (runtime/src/lib.rs:992). Sizing is stated
+    authoritatively ONCE, at podr2.PROOF_BYTES: raw payload 1032 B at
+    the defaults, plus this codec framing's constant overhead
+    (proof_wire_bytes() below computes the framed total — 1058 B at
+    the defaults), constant in the number of fragments.
 
     Both fields are FIXED-WIDTH uint32 ndarrays. sigma used to be a
     tuple of Python ints, whose varint encoding shrank whenever a limb
@@ -350,6 +385,19 @@ class Proof:
     dtype + shape + raw bytes: byte-for-byte constant in F."""
     mu: np.ndarray              # [sectors] uint32
     sigma: np.ndarray           # [limbs] uint32 F_p^limbs element
+
+
+def proof_wire_bytes(limbs: int | None = None,
+                     sectors: int = podr2.SECTORS) -> int:
+    """The exact framed wire size of an aggregated proof: the raw
+    payload (podr2.PROOF_BYTES — the ONE authoritative size statement)
+    plus this codec framing's constant overhead, computed from an
+    actual encode so it can never drift from the codec."""
+    if limbs is None:
+        limbs = podr2.LIMBS
+    return len(codec.encode(Proof(
+        mu=np.zeros((sectors,), np.uint32),
+        sigma=np.zeros((limbs,), np.uint32))))
 
 
 def build_proof(seed: bytes, owed: list[bytes],

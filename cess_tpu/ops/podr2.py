@@ -30,7 +30,8 @@ batched TPU execution:
 - Prove (miner, needs only data + tags, no secrets):
       mu[j]  = sum_{i in I} nu[i] * m[I[i], j]   (mod p, base field)
       sigma  = sum_{i in I} nu[i] * tag[I[i]]    (componentwise, F_p^2)
-  Proof size = (sectors + 2) * 4 bytes = 1032 <= 2048 = SIGMA_MAX.
+  Proof size: see PROOF_BYTES below — the ONE authoritative statement
+  of the raw payload size and its relation to the framed wire size.
 - Verify (TEE), one equation per limb, BOTH must hold:
       sigma ?= sum_i nu[i] * f_k(id, I[i]) + sum_j alpha[j] * mu[j]
 
@@ -73,7 +74,19 @@ BLOCK_BYTES = SECTORS * pf.BYTES_PER_ELEM   # 512
 # (100k fragments per round). Deployments wanting ~2^-93 pass
 # Podr2Params(limbs=3) end to end (tests run both widths).
 LIMBS = 2
-PROOF_BYTES = (SECTORS + LIMBS) * 4   # mu + sigma, 1032 <= SIGMA_MAX
+# THE authoritative aggregated-proof size statement (three separate
+# prose copies drifted to 1032/1028/1058 before r06; everything else
+# refers here). The RAW payload is mu [SECTORS] + sigma [LIMBS]
+# uint32: (SECTORS + LIMBS) * 4 = 1032 bytes at the defaults. On the
+# wire the payload travels codec-framed (node/offchain.py Proof: two
+# fixed-width ndarrays, so dtype/shape/length headers add a CONSTANT
+# overhead independent of F — 26 bytes at the defaults, 1058 B framed,
+# pinned by tests/test_podr2.py test_aggregate_proof_wire_size_constant
+# via node/offchain.py proof_wire_bytes(), which lives next to Proof
+# because framing is node-layer knowledge the ops layer must not
+# import). Both forms stay under SIGMA_MAX = 2048
+# (runtime/src/lib.rs:992), limbs=3 included.
+PROOF_BYTES = (SECTORS + LIMBS) * 4
 assert (SECTORS + 3) * 4 <= constants.SIGMA_MAX   # limbs=3 fits too
 
 
@@ -299,7 +312,10 @@ def aggregate_coeffs(seed_bytes: bytes, fragment_ids) -> jax.Array:
 
     The Shacham-Waters verification equation is linear in (mu, sigma),
     so the TEE checks the fold against the fragment set the CHAIN says
-    the miner owes — constant 1028-byte proof regardless of F.
+    the miner owes — a constant-size proof regardless of F
+    (PROOF_BYTES raw payload + constant codec framing; see the
+    authoritative statement at PROOF_BYTES, framed total computed by
+    node/offchain.py proof_wire_bytes).
     """
     import hashlib
 
@@ -363,7 +379,8 @@ def verify(key: Podr2Key, fragment_id, num_blocks: int, idx, nu, mu, sigma):
 
 
 def verify_batch(key: Podr2Key, fragment_ids, num_blocks: int, idx, nu, mu, sigma):
-    """ids [F], mu [F, s], sigma [F] -> bool [F]."""
+    """ids [F, 2] hash word pairs (or [F] scalar ids), mu [F, sectors],
+    sigma [F, limbs] -> bool [F]."""
     return jax.vmap(
         lambda i, u, s: verify(key, i, num_blocks, idx, nu, u, s)
     )(fragment_ids, mu, sigma)

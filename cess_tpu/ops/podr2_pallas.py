@@ -90,12 +90,22 @@ def _kernel(limbs: int, lanes: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(4, 5, 6),
+                   donate_argnums=(2,))
 def _tags_3d(w0: jax.Array, w1: jax.Array, prf: jax.Array,
              data: jax.Array, limbs: int, lanes: int,
              block_tile: int) -> jax.Array:
     """data [F, blocks, lanes] u8 + prf [F, limbs, blocks] ->
-    [F, limbs, blocks] tags."""
+    [F, limbs, blocks] tags.
+
+    prf is DONATED: the caller's limb-major transpose is fresh per
+    call (tag_fragments_fused builds it with moveaxis) and exactly
+    matches the output shape/dtype, so XLA can write the tags into
+    the PRF buffer instead of allocating a second [F, limbs, blocks]
+    u32 array — on an 8 MiB x 128-fragment batch that is ~16 MiB of
+    HBM per limb that never has to coexist. data is NOT donated: it
+    is a reshape VIEW of the caller's fragment buffer, which the
+    fused pipeline forward returns to its caller."""
     fcount, blocks, _ = data.shape
     interpret = _target_platform() != "tpu"
     return pl.pallas_call(

@@ -24,6 +24,7 @@ batched device kernels.
 from __future__ import annotations
 
 import functools
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -152,6 +153,16 @@ class _MatrixApply:
             return _pallas_apply(self._bmat_np, data)
         return _apply_bitmatrix(self._bmat, data)
 
+    def aot(self, shape, dtype=jnp.uint8):
+        """AOT-compile this apply for one exact input shape: the
+        tables/matrices are baked into the executable as constants
+        (pre-staged) and calls skip the jit dispatch/tracing machinery
+        entirely — the repair warm path (TPUCodec.warm_reconstruct).
+        Returns the compiled callable (data) -> result."""
+        fn = jax.jit(self.__call__)
+        return fn.lower(
+            jax.ShapeDtypeStruct(tuple(shape), dtype)).compile()
+
 
 def default_strategy() -> Strategy:
     """Pick the lowering for the current default backend.
@@ -179,6 +190,11 @@ class TPUCodec:
         self.strategy = strategy or default_strategy()
         self._parity_apply = _MatrixApply(gf.cauchy_parity_matrix(k, m), self.strategy)
         self._cache: dict[tuple, _MatrixApply] = {}
+        self._warm: dict[tuple, Callable] = {}   # AOT repair programs
+        # observable warm-path dispatches: lets callers (bench.py's
+        # fragment_repair_warm_p99_ms, tests) PROVE the warm program
+        # ran rather than a silent fallback to the cold jit path
+        self.warm_hits = 0
 
     # -- encode -------------------------------------------------------------
     def encode_parity(self, data: jax.Array) -> jax.Array:
@@ -204,18 +220,49 @@ class TPUCodec:
             self._cache[key] = _MatrixApply(mat, self.strategy)
         return self._cache[key]
 
+    def warm_reconstruct(self, present, missing=None, shape=None):
+        """Pre-compile + pre-stage the reconstruct program for ONE
+        erasure pattern and exact survivor shape (the restoral-market
+        warm path): the decode matrix is built AND baked into an AOT
+        executable now, so a later ``reconstruct`` with this pattern
+        and shape dispatches the compiled program directly — no jit
+        cache lookup, no tracing, no first-call compile in the latency
+        budget (bench.py fragment_repair_warm_p99_ms measures the
+        difference). Returns the compiled callable."""
+        present = tuple(present)
+        if missing is None:
+            missing = tuple(i for i in range(self.k + self.m)
+                            if i not in present)
+        missing = tuple(missing)
+        if shape is None:
+            raise ValueError("warm_reconstruct needs the exact "
+                             "survivor shape, e.g. (k, fragment_size)")
+        key = (present, missing, tuple(shape))
+        if key not in self._warm:
+            self._warm[key] = self._matrix_for(
+                "repair", present, missing).aot(shape)
+        return self._warm[key]
+
     def reconstruct(self, survivors: jax.Array, present: tuple[int, ...],
                     missing: tuple[int, ...] | None = None) -> jax.Array:
         """Recover missing shards from any k survivors.
 
         survivors: [..., k, n] rows ordered as ``present``; returns
         [..., len(missing), n] (missing defaults to all absent rows).
+        Dispatches a pre-compiled executable when the exact
+        (pattern, shape) has been warmed (see warm_reconstruct).
         """
         present = tuple(present)
         if missing is None:
             missing = tuple(i for i in range(self.k + self.m) if i not in present)
-        apply_ = self._matrix_for("repair", present, tuple(missing))
-        return apply_(jnp.asarray(survivors, dtype=jnp.uint8))
+        missing = tuple(missing)
+        survivors = jnp.asarray(survivors, dtype=jnp.uint8)
+        warm = self._warm.get((present, missing, tuple(survivors.shape)))
+        if warm is not None:
+            self.warm_hits += 1
+            return warm(survivors)
+        apply_ = self._matrix_for("repair", present, missing)
+        return apply_(survivors)
 
     def decode_data(self, survivors: jax.Array, present: tuple[int, ...]) -> jax.Array:
         """Recover the k data shards from any k survivors."""

@@ -8,11 +8,16 @@ requests into shape-bucketed device programs, explicit backpressure,
 and engine counters on the node metrics surface. See engine.py for
 the full design; the direct synchronous path stays the default
 everywhere an engine is not explicitly configured.
+
+stream.py adds the double-buffered host->device streaming driver for
+the fused encode+tag workload (one H2D copy per batch, staging of
+batch i+1 overlapped with compute of batch i, ragged tail handled).
 """
 from .engine import EngineFuture, SubmissionEngine, make_engine
 from .policy import (AdmissionPolicy, EngineClosed, EngineError,
                      EngineSaturated, EngineTimeout)
-from .stats import EngineStats
+from .stats import EngineStats, StreamStats
+from .stream import StreamingIngest
 
 __all__ = [
     "AdmissionPolicy",
@@ -22,6 +27,8 @@ __all__ = [
     "EngineSaturated",
     "EngineStats",
     "EngineTimeout",
+    "StreamStats",
+    "StreamingIngest",
     "SubmissionEngine",
     "make_engine",
 ]

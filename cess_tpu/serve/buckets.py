@@ -63,25 +63,39 @@ class ProgramCache:
 
     def __init__(self, stats=None, capacity: int = CAPACITY):
         import collections
+        import threading
 
         self._programs: "collections.OrderedDict[tuple, Callable]" = \
             collections.OrderedDict()
         self._stats = stats
         self.capacity = capacity
+        # the batcher thread owns steady-state lookups, but warm-path
+        # callers (SubmissionEngine.warm_repair) pre-populate from the
+        # submitter thread — the OrderedDict needs its own tiny lock
+        self._mu = threading.Lock()
 
     def __len__(self) -> int:
-        return len(self._programs)
+        with self._mu:
+            return len(self._programs)
 
     def get(self, key: tuple, build: Callable[[], Callable]) -> Callable:
-        prog = self._programs.get(key)
-        if prog is None:
-            prog = self._programs[key] = build()
-            if self._stats is not None:
-                self._stats.programs_built += 1
-            while len(self._programs) > self.capacity:
-                self._programs.popitem(last=False)
-        else:
-            self._programs.move_to_end(key)
-            if self._stats is not None:
-                self._stats.programs_reused += 1
+        with self._mu:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                if self._stats is not None:
+                    self._stats.programs_reused += 1
+                return prog
+        # build OUTSIDE the lock: builds compile device programs and
+        # must not serialize against concurrent cache hits
+        prog = build()
+        with self._mu:
+            if key not in self._programs:
+                self._programs[key] = prog
+                if self._stats is not None:
+                    self._stats.programs_built += 1
+                while len(self._programs) > self.capacity:
+                    self._programs.popitem(last=False)
+            else:
+                prog = self._programs[key]
         return prog

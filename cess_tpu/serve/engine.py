@@ -20,6 +20,18 @@ the serving layer between all of them and the ``ErasureCodec`` /
   occupancy, pad waste, per-class latency percentiles), exported via
   node/metrics.py and the ``cess_engineStats`` RPC.
 
+Zero-copy handoff: submits accept ``jax.Array`` payloads and keep
+them ON DEVICE — coalescing concatenates resident inputs with
+``jnp.concatenate``, padding pads with device zeros, and each
+request's result slice comes back as a ``jax.Array``. Host (numpy)
+submitters keep getting numpy back, even when a batch mixes both. So
+``StoragePipeline -> engine -> device`` is one H2D copy total for the
+concat-coalesced classes (encode / repair / tag / verify), provided
+the payloads live on the backend's device. The stacked classes
+(prove / verify_agg) assemble their [R, F, ...] mission batches
+host-side — their callers are host agents and their payloads are
+KiB-scale proofs, not fragment bytes.
+
 Protocol determinism is the hard constraint: engine-mediated results
 are bit-identical to the direct calls. That falls out of two facts —
 every coalesced op is row-independent (vmap / per-row GF matrix
@@ -41,6 +53,8 @@ import threading
 import time
 from typing import Any, Callable
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .buckets import ProgramCache, bucket_rows
@@ -94,6 +108,7 @@ class _Request:
     deadline: float | None
     future: EngineFuture
     squeeze: bool = False    # 2-D submit: drop the batch axis on return
+    device: bool = False     # jax.Array payload: result stays on device
 
 
 def _round_digest(num_blocks: int, idx, nu) -> bytes:
@@ -104,9 +119,32 @@ def _round_digest(num_blocks: int, idx, nu) -> bytes:
     return h.digest()[:16]
 
 
-def _pad_axis0(arr: np.ndarray, rows: int) -> np.ndarray:
+def _norm(arr, dtype):
+    """Normalize a payload WITHOUT forcing it off its device: jax
+    arrays stay jax (dtype-cast on device when needed), everything
+    else becomes a contiguous numpy array."""
+    if isinstance(arr, jax.Array):
+        return arr if arr.dtype == dtype else arr.astype(dtype)
+    return np.ascontiguousarray(np.asarray(arr, dtype=dtype))
+
+
+def _concat_rows(arrs: list):
+    """Coalesce request payloads along axis 0 — ON DEVICE when any
+    contributor is device-resident (one H2D per host contributor,
+    zero for resident ones), plain numpy otherwise."""
+    if any(isinstance(a, jax.Array) for a in arrs):
+        if len(arrs) == 1:
+            return arrs[0]
+        return jnp.concatenate([jnp.asarray(a) for a in arrs], axis=0)
+    return np.concatenate(arrs, axis=0)
+
+
+def _pad_axis0(arr, rows: int):
     if arr.shape[0] == rows:
         return arr
+    if isinstance(arr, jax.Array):
+        pad = jnp.zeros((rows - arr.shape[0],) + arr.shape[1:], arr.dtype)
+        return jnp.concatenate([arr, pad], axis=0)
     pad = np.zeros((rows - arr.shape[0],) + arr.shape[1:], arr.dtype)
     return np.concatenate([arr, pad], axis=0)
 
@@ -197,10 +235,8 @@ class SubmissionEngine:
         """ids [F, 2] uint32, fragments [F, bytes] uint8 -> future of
         tags [F, blocks, limbs]."""
         self._need_audit()
-        ids = np.ascontiguousarray(np.asarray(fragment_ids,
-                                              dtype=np.uint32))
-        frags = np.ascontiguousarray(np.asarray(fragments,
-                                                dtype=np.uint8))
+        ids = _norm(fragment_ids, np.uint32)
+        frags = _norm(fragments, np.uint8)
         if ids.ndim != 2 or ids.shape[1] != 2 or frags.ndim != 2 \
                 or ids.shape[0] != frags.shape[0]:
             raise ValueError("expected ids [F, 2] and fragments [F, bytes]")
@@ -258,10 +294,9 @@ class SubmissionEngine:
         [F, limbs] -> future of bool [F]. Coalesces along F across
         requests of the same round."""
         self._need_audit()
-        ids = np.ascontiguousarray(np.asarray(fragment_ids,
-                                              dtype=np.uint32))
-        mu = np.ascontiguousarray(np.asarray(mu, dtype=np.uint32))
-        sigma = np.ascontiguousarray(np.asarray(sigma, dtype=np.uint32))
+        ids = _norm(fragment_ids, np.uint32)
+        mu = _norm(mu, np.uint32)
+        sigma = _norm(sigma, np.uint32)
         idx = np.asarray(idx)
         nu = np.asarray(nu)
         if ids.ndim != 2 or mu.ndim != 2 or sigma.ndim != 2 \
@@ -317,6 +352,59 @@ class SubmissionEngine:
     # ------------------------------------------------------------------
     # lifecycle / introspection
     # ------------------------------------------------------------------
+    def warm_repair(self, patterns, n: int, buckets=(1, 2)) -> None:
+        """Pre-compile + pre-stage the repair-class programs for the
+        given erasure patterns so a restoral-market claim pays kernel
+        time, never compile/staging time (the warm path behind the
+        fragment_repair_warm_p99_ms bench metric).
+
+        patterns: iterable of (present, missing) row tuples;
+        n: shard byte width; buckets: row-bucket sizes to warm. The
+        default covers a solo claim (bucket 1) AND two same-pattern
+        claims coalescing in the batching window (bucket 2) — wider
+        coalescence pads to a bucket that was never warmed and pays
+        one cold compile; pass more buckets when many miners race the
+        same restoral order (each warmed bucket costs one AOT compile
+        per pattern at warm time).
+
+        Populates the engine program cache under the exact keys
+        ``_op_repair`` will look up, and — when the codec supports it
+        (TPUCodec.warm_reconstruct) — AOT-compiles the underlying
+        reconstruct program with its decode matrix baked in."""
+        self._need_codec()
+        warm = getattr(self.codec, "warm_reconstruct", None)
+        for present, missing in patterns:
+            present, missing = tuple(present), tuple(missing)
+            for b in buckets:
+                bucket = bucket_rows(b)
+                if warm is not None:
+                    warm(present, missing,
+                         (bucket, len(present), n))
+                self.programs.get(
+                    ("repair", present, missing, n, bucket),
+                    lambda p=present, mi=missing:
+                        (lambda a: self.codec.reconstruct(a, p, mi)))
+
+    def attach_stream(self, stream_stats) -> None:
+        """Register a streaming driver's StreamStats so its per-stage
+        occupancy/stall counters ride the ``cess_engine_*`` metrics
+        surface (serve/stream.py). Attach ONE long-lived driver per
+        stream source and detach it when the source is done — the
+        exported stream gauges are summed over every attached driver,
+        so abandoned registrations dilute the bound-where signal."""
+        with self._lock:
+            self.stats.streams.append(stream_stats)
+
+    def detach_stream(self, stream_stats) -> None:
+        """Unregister a driver's StreamStats (identity match); its
+        counters stop contributing to the merged gauges. Unknown stats
+        objects are ignored (idempotent)."""
+        with self._lock:
+            try:
+                self.stats.streams.remove(stream_stats)
+            except ValueError:
+                pass
+
     def stats_snapshot(self) -> dict:
         with self._lock:
             return self.stats.snapshot(
@@ -380,8 +468,8 @@ class SubmissionEngine:
             raise ValueError("engine has no AuditBackend configured")
 
     @staticmethod
-    def _norm_shards(data, rows: int) -> tuple[np.ndarray, bool]:
-        arr = np.ascontiguousarray(np.asarray(data, dtype=np.uint8))
+    def _norm_shards(data, rows: int):
+        arr = _norm(data, np.uint8)
         squeeze = arr.ndim == 2
         if squeeze:
             arr = arr[None]
@@ -399,10 +487,11 @@ class SubmissionEngine:
         if timeout is None:
             timeout = self.policy.default_timeout
         fut = EngineFuture()
+        device = any(isinstance(a, jax.Array) for a in arrays.values())
         req = _Request(cls=cls, key=key, rows=rows, arrays=arrays,
                        aux=aux, enqueue_t=now,
                        deadline=None if timeout is None else now + timeout,
-                       future=fut, squeeze=squeeze)
+                       future=fut, squeeze=squeeze, device=device)
         with self._cond:
             if self._closed:
                 raise EngineClosed("engine is shut down")
@@ -566,29 +655,47 @@ class SubmissionEngine:
             r.future._resolve(res)
 
     # -- op runners (batcher thread only) -------------------------------
-    def _split_rows(self, batch: list[_Request], out: np.ndarray) -> list:
+    def _split_rows(self, batch: list[_Request], out) -> list:
+        """Slice a batch result back per request. Device submitters get
+        ``jax.Array`` slices (no host materialization anywhere on their
+        path); an all-host batch is fetched ONCE and sliced as numpy.
+
+        The result is synced BEFORE futures resolve: zero-copy means
+        no D2H transfer, not fire-and-forget — a future must mean
+        "this batch actually completed", the per-class latency
+        percentiles must measure enqueue->completion (not async
+        dispatch), and a device-side execution failure must reject the
+        batch through _run_batch's error path instead of resolving
+        futures with poisoned arrays."""
+        if isinstance(out, jax.Array):
+            jax.block_until_ready(out)
+            if not any(r.device for r in batch):
+                out = np.asarray(out)
         results, off = [], 0
         for r in batch:
             piece = out[off:off + r.rows]
+            if r.device and not isinstance(piece, jax.Array):
+                piece = jnp.asarray(piece)
+            elif not r.device and isinstance(piece, jax.Array):
+                piece = np.asarray(piece)
             results.append(piece[0] if r.squeeze else piece)
             off += r.rows
         return results
 
     def _op_encode(self, batch):
-        data = np.concatenate([r.arrays["data"] for r in batch], axis=0)
+        data = _concat_rows([r.arrays["data"] for r in batch])
         total = data.shape[0]
         bucket = bucket_rows(total)
         _, k, n = data.shape
         prog = self.programs.get(("encode", k, n, bucket),
                                  lambda: self.codec.encode)
-        out = np.asarray(prog(_pad_axis0(data, bucket)))[:total]
+        out = prog(_pad_axis0(data, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
     def _op_repair(self, batch):
         kind = batch[0].key[1]
         aux = batch[0].aux
-        surv = np.concatenate([r.arrays["survivors"] for r in batch],
-                              axis=0)
+        surv = _concat_rows([r.arrays["survivors"] for r in batch])
         total = surv.shape[0]
         bucket = bucket_rows(total)
         n = surv.shape[2]
@@ -603,28 +710,26 @@ class SubmissionEngine:
             prog = self.programs.get(
                 ("decode", present, n, bucket),
                 lambda: (lambda a: self.codec.decode_data(a, present)))
-        out = np.asarray(prog(_pad_axis0(surv, bucket)))[:total]
+        out = prog(_pad_axis0(surv, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
     def _op_tag(self, batch):
-        ids = np.concatenate([r.arrays["ids"] for r in batch], axis=0)
-        frags = np.concatenate([r.arrays["fragments"] for r in batch],
-                               axis=0)
+        ids = _concat_rows([r.arrays["ids"] for r in batch])
+        frags = _concat_rows([r.arrays["fragments"] for r in batch])
         total = frags.shape[0]
         bucket = bucket_rows(total)
         nbytes = frags.shape[1]
         prog = self.programs.get(("tag", nbytes, bucket),
                                  lambda: self.audit.tag_fragments)
-        out = np.asarray(prog(_pad_axis0(ids, bucket),
-                              _pad_axis0(frags, bucket)))[:total]
+        out = prog(_pad_axis0(ids, bucket),
+                   _pad_axis0(frags, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
     def _op_verify_batch(self, batch):
         aux = batch[0].aux
-        ids = np.concatenate([r.arrays["ids"] for r in batch], axis=0)
-        mu = np.concatenate([r.arrays["mu"] for r in batch], axis=0)
-        sigma = np.concatenate([r.arrays["sigma"] for r in batch],
-                               axis=0)
+        ids = _concat_rows([r.arrays["ids"] for r in batch])
+        mu = _concat_rows([r.arrays["mu"] for r in batch])
+        sigma = _concat_rows([r.arrays["sigma"] for r in batch])
         total = ids.shape[0]
         bucket = bucket_rows(total)
         num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
@@ -632,14 +737,12 @@ class SubmissionEngine:
             ("verify_batch", batch[0].key, bucket),
             lambda: (lambda i, u, s: self.audit.verify_batch(
                 i, num_blocks, idx, nu, u, s)))
-        out = np.asarray(prog(_pad_axis0(ids, bucket),
-                              _pad_axis0(mu, bucket),
-                              _pad_axis0(sigma, bucket)))[:total]
+        out = prog(_pad_axis0(ids, bucket),
+                   _pad_axis0(mu, bucket),
+                   _pad_axis0(sigma, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
     def _op_verify_agg(self, batch):
-        import jax
-
         from ..ops import podr2
 
         aux = batch[0].aux
@@ -674,8 +777,6 @@ class SubmissionEngine:
         return results, rb * fb
 
     def _op_prove(self, batch):
-        import jax
-
         from ..ops import podr2
 
         aux = batch[0].aux

@@ -57,13 +57,68 @@ class ClassStats:
         return xs[min(len(xs) - 1, int(q * len(xs)))]
 
 
+class StreamStats:
+    """Per-stage counters for the double-buffered streaming driver
+    (serve/stream.py). One instance per driver; attach to an engine
+    (SubmissionEngine.attach_stream) to export through the same
+    ``cess_engine_*`` exposition, prefixed ``cess_engine_stream_``.
+
+    Reading the two stage clocks against wall time tells you where the
+    streamed workload is bound:
+    - ``stall_s`` is host time spent BLOCKED on device results (the
+      in-flight throttle + final drain) — a high stall fraction means
+      the device is saturated: good occupancy, compute-bound.
+    - ``h2d_s`` is host time spent staging bytes to the device — a
+    high h2d fraction with near-zero stall means the transfer side
+    cannot keep the chip busy: transfer-bound, the overlap is the
+    only thing hiding it.
+    """
+
+    __slots__ = ("batches", "segments", "padded_segments", "bytes_in",
+                 "h2d_s", "dispatch_s", "stall_s", "wall_s")
+
+    def __init__(self):
+        self.batches = 0           # device batches dispatched
+        self.segments = 0          # real segments ingested
+        self.padded_segments = 0   # zero rows added to the ragged tail
+        self.bytes_in = 0          # host bytes staged (real, not pad)
+        self.h2d_s = 0.0           # host time in device_put staging
+        self.dispatch_s = 0.0      # host time dispatching the program
+        self.stall_s = 0.0         # host time blocked on device results
+        self.wall_s = 0.0          # wall time of completed run() calls
+
+    def raw(self) -> dict:
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    def snapshot(self) -> dict:
+        return stream_gauges(self.raw())
+
+    def metrics(self) -> dict[str, float]:
+        return {f"cess_engine_stream_{k}": float(v)
+                for k, v in self.snapshot().items()}
+
+
+def stream_gauges(raw: dict) -> dict:
+    """Derived per-stage gauges from raw StreamStats counters (shared
+    by a single driver's snapshot and the engine's cross-stream sum)."""
+    out = dict(raw)
+    wall = raw["wall_s"]
+    out["stall_frac"] = round(raw["stall_s"] / wall, 4) if wall else 0.0
+    out["h2d_frac"] = round(raw["h2d_s"] / wall, 4) if wall else 0.0
+    for k in ("h2d_s", "dispatch_s", "stall_s", "wall_s"):
+        out[k] = round(out[k], 6)
+    return out
+
+
 class EngineStats:
-    """One ClassStats per op class + engine-wide program-cache counts."""
+    """One ClassStats per op class + engine-wide program-cache counts
+    (+ any attached streaming drivers' stage counters)."""
 
     def __init__(self):
         self.classes = {c: ClassStats() for c in policy.CLASSES}
         self.programs_built = 0     # program-cache misses (compiles)
         self.programs_reused = 0    # program-cache hits
+        self.streams: list[StreamStats] = []   # attached stream drivers
 
     def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
         """JSON-shaped dump for the RPC debug endpoint."""
@@ -85,6 +140,8 @@ class EngineStats:
                 "latency_p50": round(st.percentile(0.50), 6),
                 "latency_p99": round(st.percentile(0.99), 6),
             }
+        if self.streams:
+            out["streams"] = [s.snapshot() for s in self.streams]
         return out
 
     def metrics(self, queue_depths: dict[str, int] | None = None
@@ -96,4 +153,13 @@ class EngineStats:
         for cls, st in snap["classes"].items():
             for name, val in st.items():
                 out[f"cess_engine_{cls}_{name}"] = val
+        if self.streams:
+            # sum RAW counters across attached drivers, then derive —
+            # adding per-driver fractions would be meaningless
+            totals = self.streams[0].raw()
+            for s in self.streams[1:]:
+                for k, v in s.raw().items():
+                    totals[k] += v
+            for name, val in stream_gauges(totals).items():
+                out[f"cess_engine_stream_{name}"] = float(val)
         return out

@@ -1,0 +1,240 @@
+"""Double-buffered host->device streaming driver for the flagship
+encode+tag workload.
+
+Every BASELINE metric is measured device-resident, but the real
+OSS-gateway workload (SURVEY.md §3.2) ingests a STREAM of 16 MiB
+segments from the host. Round-tripping each batch through the host
+between encode and tag, and serializing transfer against compute,
+throws away exactly the throughput the kernels won — erasure-coding
+pipelines live or die on transfer/compute overlap once the kernel is
+fast (PAPERS: "Accelerating XOR-based Erasure Coding using Program
+Optimization Techniques"), and ragged batched TPU streams need
+dedicated staging to keep the chip busy (PAPERS: "Ragged Paged
+Attention ... for TPU").
+
+:class:`StreamingIngest` drives the pipeline's FUSED encode+tag
+program (models/pipeline.py ``fused_program``: one jitted call, the
+segment buffer donated) over a host byte stream:
+
+- each batch is staged ONCE with ``jax.device_put`` (one H2D copy from
+  host bytes to device tags — the fused program never materializes an
+  intermediate on the host);
+- dispatch is asynchronous, so staging batch i+1 overlaps the device
+  computing batch i (double buffering falls out of async dispatch +
+  a bounded in-flight window: at most ``depth`` batches are enqueued
+  before the driver blocks on the oldest);
+- the ragged final batch is padded with zero segments to the SAME
+  program shape (no tail recompile; every pipeline op is
+  row-independent, so the pad rows are sliced off bit-exactly);
+- every stage is counted in :class:`~cess_tpu.serve.stats.StreamStats`
+  (staging time, dispatch time, stall time, pad waste) and exported
+  through the engine's ``cess_engine_stream_*`` metrics when attached
+  (SubmissionEngine.attach_stream).
+
+Results are bit-identical to the direct per-step path
+(``encode_step`` -> ``tag_step``) — tests/test_stream.py pins this on
+both MAC limb widths, including the ragged tail.
+
+For multi-chip meshes, cess_tpu/parallel/mesh.py ``stream_entry``
+builds the (program, put, put_ids) triple that shards each staged
+batch over (seg, byte); the driver is topology-agnostic.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import _pad_axis0
+from .stats import StreamStats
+
+
+def _as_host_array(source):
+    """Coerce a whole-source 2-D array-like (jax.Array included) to a
+    host ndarray in ONE fetch; anything else (a chunk iterable) passes
+    through untouched. Shared by run()'s validation and _rebatch so
+    the two paths can never accept different source types — and so a
+    device-resident source is never iterated row-by-row (one blocking
+    D2H per segment)."""
+    if not isinstance(source, np.ndarray) \
+            and getattr(source, "ndim", None) == 2:
+        return np.asarray(source)
+    return source
+
+
+def _rebatch(source, batch: int) -> Iterator[np.ndarray]:
+    """Yield [<=batch, seg] host chunks from an array or an iterable of
+    row chunks (a network receive loop hands arbitrary-sized pieces)."""
+    source = _as_host_array(source)
+    if isinstance(source, np.ndarray):
+        for start in range(0, source.shape[0], batch):
+            yield source[start:start + batch]
+        return
+    pending: list[np.ndarray] = []
+    rows = 0
+    for piece in source:
+        piece = np.asarray(piece)
+        if piece.ndim == 1:
+            piece = piece[None]
+        pending.append(piece)
+        rows += piece.shape[0]
+        while rows >= batch:
+            buf = np.concatenate(pending, axis=0) if len(pending) > 1 \
+                else pending[0]
+            yield buf[:batch]
+            rest = buf[batch:]
+            pending = [rest] if rest.shape[0] else []
+            rows = rest.shape[0]
+    if rows:
+        yield np.concatenate(pending, axis=0) if len(pending) > 1 \
+            else pending[0]
+
+
+class StreamingIngest:
+    """See module doc. One instance per stream source; safe to reuse
+    for consecutive runs (counters accumulate across runs).
+
+    pipeline: the StoragePipeline whose fused program to drive.
+    batch:    segments per device batch (the compiled shape).
+    depth:    in-flight window — batches enqueued on the device before
+              the driver blocks on the oldest (2 = classic double
+              buffering: one computing, one staged).
+    program:  override the device program (fn(segments, ids) -> dict
+              with "fragments"/"tags") — the mesh entry passes its
+              shard_map'd step here.
+    put / put_ids: override staging (default jax.device_put) — the
+              mesh entry passes sharded placements.
+    engine:   optional SubmissionEngine to export stats through.
+    """
+
+    def __init__(self, pipeline, batch: int, *, depth: int = 2,
+                 program=None, put=None, put_ids=None, stats=None,
+                 engine=None):
+        if batch < 1 or depth < 1:
+            raise ValueError(f"bad stream shape: batch={batch}, "
+                             f"depth={depth}")
+        self.pipeline = pipeline
+        self.batch = batch
+        self.depth = depth
+        self.stats = stats or StreamStats()
+        self._program = program
+        self._put = put or jax.device_put
+        self._put_ids = put_ids or self._put
+        self._engine = engine
+        if engine is not None:
+            engine.attach_stream(self.stats)
+
+    def detach(self) -> None:
+        """Stop contributing to the attached engine's merged
+        cess_engine_stream_* gauges (call when this stream source is
+        done; idempotent, no-op without an engine). Construct ONE
+        driver per long-lived source rather than one per request —
+        attachments are summed, not replaced."""
+        if self._engine is not None:
+            self._engine.detach_stream(self.stats)
+            self._engine = None
+
+    # ------------------------------------------------------------------
+    def run(self, segments, fragment_ids=None) -> Iterator[dict]:
+        """Stream host segments through the device; yield per-batch
+        ``{"fragments", "tags", "rows"}`` dicts of DEVICE arrays
+        (ragged tail already sliced to its real rows). Each yielded
+        batch is complete on device (the in-flight throttle blocks
+        before yielding), so consumers never observe partial results.
+
+        segments: [N, segment_size] uint8 host array, or an iterable
+        of row chunks (rebatched internally). fragment_ids: optional
+        [N, k+m] or [N, k+m, 2] array (requires an array source); None
+        uses the bench/demo arange over the global row index — exactly
+        the default the direct path would use over the whole array.
+
+        Input validation happens HERE, at call time (run() is a plain
+        method delegating to an inner generator), so a bad call fails
+        at its own site rather than at the consumer's first next().
+        """
+        if fragment_ids is not None:
+            segments = _as_host_array(segments)
+            if not isinstance(segments, np.ndarray) \
+                    or segments.ndim != 2:
+                # a generator/chunked source cannot be lined up with a
+                # pre-shaped id array — reject loudly instead of the
+                # opaque shape errors np coercion would produce
+                raise ValueError(
+                    "fragment_ids requires an [N, segment_size] array "
+                    "segment source, not a chunked/iterator source")
+            fragment_ids = np.asarray(fragment_ids)
+            if fragment_ids.shape[0] != segments.shape[0]:
+                raise ValueError("fragment_ids rows != segments rows")
+        return self._run(segments, fragment_ids)
+
+    def _run(self, segments, fragment_ids) -> Iterator[dict]:
+        cfg = self.pipeline.config
+        rows = cfg.k + cfg.m
+        program = self._program or self.pipeline.fused_program()
+        st = self.stats
+        t_run = time.perf_counter()
+        inflight: collections.deque = collections.deque()
+
+        def drain_one():
+            out, real = inflight.popleft()
+            t0 = time.perf_counter()
+            jax.block_until_ready(out["tags"])
+            st.stall_s += time.perf_counter() - t0
+            if real < self.batch:
+                out = {k: v[:real] for k, v in out.items()}
+            out["rows"] = real
+            return out
+
+        try:
+            seg_off = 0
+            for chunk in _rebatch(segments, self.batch):
+                # enforce the in-flight window BEFORE staging the next
+                # batch: at most ``depth`` batches are ever enqueued
+                # (depth=2 = one computing + one staged), which is what
+                # bounds in-flight device memory
+                while len(inflight) >= self.depth:
+                    yield drain_one()
+                chunk = np.ascontiguousarray(chunk, dtype=np.uint8)
+                real = chunk.shape[0]
+                if real < self.batch:          # ragged tail: pad, reuse
+                    chunk = _pad_axis0(chunk, self.batch)
+                    st.padded_segments += self.batch - real
+                if fragment_ids is None:
+                    ids = np.arange(seg_off * rows,
+                                    (seg_off + self.batch) * rows,
+                                    dtype=np.int32)
+                else:
+                    ids = _pad_axis0(fragment_ids[seg_off:seg_off + real],
+                                     self.batch)
+                t0 = time.perf_counter()
+                dev = self._put(chunk)
+                ids_dev = self._put_ids(ids)
+                st.h2d_s += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                out = program(dev, ids_dev)
+                st.dispatch_s += time.perf_counter() - t0
+                st.batches += 1
+                st.segments += real
+                st.bytes_in += real * cfg.segment_size
+                seg_off += self.batch
+                inflight.append((out, real))
+            while inflight:
+                yield drain_one()
+        finally:
+            st.wall_s += time.perf_counter() - t_run
+
+    def ingest(self, segments, fragment_ids=None) -> dict:
+        """Run the whole stream and concatenate the per-batch device
+        results — the convenience form for callers that want the full
+        ``forward``-shaped output without managing the generator."""
+        outs = list(self.run(segments, fragment_ids))
+        if not outs:
+            raise ValueError("empty segment stream")
+        return {"fragments": jnp.concatenate([o["fragments"]
+                                              for o in outs], axis=0),
+                "tags": jnp.concatenate([o["tags"] for o in outs],
+                                        axis=0)}

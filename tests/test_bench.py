@@ -1,0 +1,57 @@
+"""bench.py --smoke is a tier-1 gate: every metric code path must run
+CPU-safe on tiny shapes and produce a finite positive value, so bench
+code paths cannot silently rot between measurement rounds (the metrics
+only run on the real chip otherwise). Also pins the r06 satellites:
+raw per-side speedup timings recorded, the warm repair metric emitted
+separately from cold dispatch, and the streamed from-host-bytes metric
+reporting its stage counters.
+"""
+import json
+import math
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXPECTED = (
+    "rs_4erasure_decode_GiBps_per_chip",
+    "fragment_repair_p99_ms",
+    "fragment_repair_warm_p99_ms",
+    "podr2_100k_tag_verify_frags_per_s",
+    "stream_encode_tag_GiBps",
+    "rs_4p8_encode_GiBps_per_chip",
+)
+
+
+def test_bench_smoke_every_metric_finite():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, cwd=REPO, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    got = {r["metric"]: r for r in recs}
+    for name in EXPECTED:
+        assert name in got, f"missing metric {name}"
+        v = got[name]["value"]
+        assert math.isfinite(v) and v > 0, (name, v)
+    # the speedup metric (either the native name or the renamed numpy
+    # fallback) records RAW per-side timings (r05 drift satellite)
+    speedup = next(r for r in recs
+                   if r["metric"].startswith("cpu_speedup_encode"))
+    assert math.isfinite(speedup["value"]) and speedup["value"] > 0
+    for field in ("device_GiBps", "cpu_GiBps", "device_window_GiBps",
+                  "cpu_times_ms"):
+        assert field in speedup, field
+    assert len(speedup["cpu_times_ms"]) >= 5
+    # warm repair is measured separately from cold dispatch
+    warm = got["fragment_repair_warm_p99_ms"]
+    assert warm["cold_compile_first_call_ms"] > 0
+    # the streamed metric reports its per-stage counters
+    stream = got["stream_encode_tag_GiBps"]
+    assert stream["batches"] >= 1 and stream["segments"] >= 1
+    assert stream["padded_segments"] >= 1          # ragged tail hit
+    for field in ("h2d_s", "dispatch_s", "stall_s", "stall_frac"):
+        assert field in stream, field

@@ -334,6 +334,15 @@ class TestLockDiscipline:
         r = analysis.lint_paths([path], root=REPO)
         assert [f.format() for f in r.findings] == []
 
+    def test_stream_driver_is_clean(self):
+        """r06 satellite: the double-buffered streaming driver (host
+        loops + device handoffs, a prime trace-safety/lock target)
+        passes the serve/ analyzer families with zero findings."""
+        paths = [os.path.join(REPO, "cess_tpu", "serve", f)
+                 for f in ("stream.py", "stats.py", "buckets.py")]
+        r = analysis.lint_paths(paths, root=REPO)
+        assert [f.format() for f in r.findings] == []
+
     def test_node_locking_layers_are_clean(self):
         paths = [os.path.join(REPO, "cess_tpu", "node", f)
                  for f in ("net.py", "rpc.py", "dht.py")]

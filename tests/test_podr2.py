@@ -181,6 +181,12 @@ def test_aggregate_proof_wire_size_constant():
         assert isinstance(proof, Proof)
         sizes.append(len(blob))
     assert sizes[0] == sizes[1], "proof size must not grow with F"
+    # the authoritative size statement (podr2.PROOF_BYTES + constant
+    # codec framing, r06 satellite) matches the real wire bytes
+    from cess_tpu.node.offchain import proof_wire_bytes
+
+    assert sizes[0] == proof_wire_bytes()
+    assert proof_wire_bytes() - podr2.PROOF_BYTES == 26
 
 
 def test_tag_oracle_parity_numpy_bigint():

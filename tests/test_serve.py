@@ -129,6 +129,101 @@ def test_verify_aggregate_coalesces_ragged_missions(pkey):
         eng.close()
 
 
+# -- zero-copy device handoff ----------------------------------------------
+
+def test_engine_zero_copy_device_arrays(pkey):
+    """jax.Array in -> jax.Array out (no forced np.asarray anywhere on
+    the device submitter's path), values bit-identical to direct; host
+    (numpy) submitters keep getting numpy back."""
+    import jax
+    import jax.numpy as jnp
+
+    eng = make_engine(K, M, rs_backend="jax", podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        codec = rs.make_codec(K, M, backend="cpu")
+        host = rnd((2, K, 256), 1)
+        dev = jnp.asarray(host)
+        out = eng.encode(dev)
+        assert isinstance(out, jax.Array)
+        assert np.array_equal(np.asarray(out), codec.encode(host))
+        np_out = eng.encode(host)
+        assert isinstance(np_out, np.ndarray)
+        assert np.array_equal(np_out, np.asarray(out))
+        # tag + verify classes round-trip on device too
+        frags = jnp.asarray(rnd((3, FRAG), 2))
+        ids = jnp.asarray(rnd((3, 2), 3, dtype=np.uint32))
+        tags = eng.tag_fragments(ids, frags)
+        assert isinstance(tags, jax.Array)
+        direct = np.asarray(podr2.tag_fragments(pkey, ids, frags))
+        assert np.array_equal(np.asarray(tags), direct)
+        blocks = tags.shape[1]
+        idx, nu = podr2.gen_challenge(b"round-zc", blocks)
+        mu_b, sigma_b = podr2.prove_batch(frags, tags, idx, nu)
+        ok = eng.verify_batch(jnp.asarray(ids), blocks, idx, nu,
+                              jnp.asarray(mu_b), jnp.asarray(sigma_b))
+        assert isinstance(ok, jax.Array) and np.asarray(ok).all()
+    finally:
+        eng.close()
+
+
+def test_mixed_host_device_batch_coalesces(pkey):
+    """A device submitter and a host submitter coalesce into ONE
+    batch; each gets its own domain back and both match direct."""
+    import jax
+    import jax.numpy as jnp
+
+    codec = rs.make_codec(K, M, backend="cpu")
+    eng = make_engine(K, M, rs_backend="jax",
+                      policy=AdmissionPolicy(max_delay=0.25))
+    try:
+        host = rnd((2, K, 128), 4)
+        dev = jnp.asarray(rnd((3, K, 128), 5))
+        f_host = eng.submit_encode(host)
+        f_dev = eng.submit_encode(dev)
+        out_host = f_host.result(timeout=30)
+        out_dev = f_dev.result(timeout=30)
+        assert isinstance(out_host, np.ndarray)
+        assert isinstance(out_dev, jax.Array)
+        assert np.array_equal(out_host, codec.encode(host))
+        assert np.array_equal(np.asarray(out_dev),
+                              codec.encode(np.asarray(dev)))
+        st = eng.stats_snapshot()["classes"]["encode"]
+        assert st["batches"] == 1 and st["batch_occupancy"] == 2
+    finally:
+        eng.close()
+
+
+def test_pipeline_engine_path_returns_device_arrays(pkey):
+    """StoragePipeline -> engine -> device is one handoff: the engine
+    path hands back jax.Array results identical to the direct path."""
+    import jax
+    import jax.numpy as jnp
+
+    from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+
+    cfg = PipelineConfig(k=K, m=M, segment_size=K * FRAG)
+    eng = make_engine(K, M, rs_backend="jax", podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        piped = StoragePipeline(cfg, podr2_key=pkey, engine=eng)
+        direct = StoragePipeline(cfg, podr2_key=pkey)
+        # host segments: the fused direct path donates its staged
+        # device copy on accelerators, so the shared input stays numpy
+        segs = rnd((2, K * FRAG), 6)
+        ids = jnp.asarray(rnd((2, K + M, 2), 7, dtype=np.uint32))
+        out = piped.forward(segs, ids)
+        assert isinstance(out["fragments"], jax.Array)
+        assert isinstance(out["tags"], jax.Array)
+        ref = direct.forward(segs, ids)
+        assert np.array_equal(np.asarray(out["fragments"]),
+                              np.asarray(ref["fragments"]))
+        assert np.array_equal(np.asarray(out["tags"]),
+                              np.asarray(ref["tags"]))
+    finally:
+        eng.close()
+
+
 # -- pipeline + offchain wiring --------------------------------------------
 
 def test_pipeline_engine_matches_direct(pkey):

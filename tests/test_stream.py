@@ -1,0 +1,299 @@
+"""Streamed ingest (serve/stream.py) + fused forward (models/pipeline):
+
+- the fused encode+tag forward is bit-identical to the separate
+  encode_step -> tag_step path;
+- the double-buffered streaming driver is bit-identical to the direct
+  path on BOTH MAC limb widths (Podr2Params limbs=2/3), including the
+  ragged final batch and explicit hash-pair ids;
+- the sharded mesh stream entry matches the single-device fused path
+  (topology invariance extends to the streaming program);
+- stream stage counters are exact and export through the engine's
+  cess_engine_stream_* metrics surface;
+- the repair warm path (rs.py warm_reconstruct / engine.warm_repair)
+  returns byte-exact reconstructions through pre-compiled programs.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+from cess_tpu.ops import podr2, rs
+from cess_tpu.serve import AdmissionPolicy, make_engine
+from cess_tpu.serve.stream import StreamingIngest, _rebatch
+
+K, M = 2, 1
+FRAG = 1024                 # 2 PoDR2 blocks per fragment
+SEG = K * FRAG
+ROWS = K + M
+
+
+def rnd(shape, seed=0, dtype=np.uint8):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, np.iinfo(dtype).max, shape, dtype=dtype)
+
+
+def make_pipe(limbs=2):
+    params = podr2.Podr2Params(limbs=limbs)
+    key = podr2.Podr2Key.generate(31, params)
+    return StoragePipeline(PipelineConfig(k=K, m=M, segment_size=SEG),
+                           podr2_key=key)
+
+
+# -- fused forward ----------------------------------------------------------
+
+def test_fused_forward_matches_per_step():
+    pipe = make_pipe()
+    segs = rnd((4, SEG), 1)
+    out = pipe.forward(segs)
+    shards = pipe.encode_step(segs)
+    tags = pipe.tag_step(shards)
+    assert np.array_equal(np.asarray(out["fragments"]),
+                          np.asarray(shards))
+    assert np.array_equal(np.asarray(out["tags"]), np.asarray(tags))
+
+
+def test_fused_forward_explicit_pair_ids():
+    pipe = make_pipe()
+    segs = rnd((3, SEG), 2)
+    ids = rnd((3, ROWS, 2), 3, dtype=np.uint32)
+    out = pipe.forward(segs, fragment_ids=ids)
+    shards = pipe.encode_step(segs)
+    tags = pipe.tag_step(shards, ids)
+    assert np.array_equal(np.asarray(out["tags"]), np.asarray(tags))
+
+
+# -- streamed driver vs direct ---------------------------------------------
+
+@pytest.mark.parametrize("limbs", [2, 3])
+def test_stream_bit_identical_both_limb_widths(limbs):
+    """7 segments through batches of 3: two full batches plus a ragged
+    1-segment tail, default (global arange) ids — bit-identical to the
+    direct per-step path over the whole array at once."""
+    pipe = make_pipe(limbs)
+    segs = rnd((7, SEG), 10 + limbs)
+    shards = pipe.encode_step(segs)
+    tags = pipe.tag_step(shards)            # arange over all 7*ROWS
+    ing = StreamingIngest(pipe, 3)
+    out = ing.ingest(segs)
+    assert out["tags"].shape[-1] == limbs
+    assert np.array_equal(np.asarray(out["fragments"]),
+                          np.asarray(shards))
+    assert np.array_equal(np.asarray(out["tags"]), np.asarray(tags))
+    st = ing.stats
+    assert st.batches == 3
+    assert st.segments == 7
+    assert st.padded_segments == 2          # tail padded 1 -> 3
+    assert st.bytes_in == 7 * SEG
+
+
+def test_stream_explicit_ids_and_device_results():
+    pipe = make_pipe()
+    segs = rnd((5, SEG), 20)
+    ids = rnd((5, ROWS, 2), 21, dtype=np.uint32)
+    outs = list(StreamingIngest(pipe, 2).run(segs, fragment_ids=ids))
+    assert [o["rows"] for o in outs] == [2, 2, 1]   # ragged tail sliced
+    for o in outs:
+        assert isinstance(o["tags"], jax.Array)     # stays on device
+    got = np.concatenate([np.asarray(o["tags"]) for o in outs])
+    want = np.asarray(pipe.tag_step(pipe.encode_step(segs), ids))
+    assert np.array_equal(got, want)
+
+
+def test_stream_iterable_source_rebatches():
+    """A chunked source (the network-receive shape) re-batches into
+    the compiled batch size; results identical to the array source."""
+    pipe = make_pipe()
+    segs = rnd((6, SEG), 30)
+    pieces = [segs[0:1], segs[1:4], segs[4:6]]      # ragged chunks
+    got = StreamingIngest(pipe, 4).ingest(iter(pieces))
+    want = StreamingIngest(pipe, 4).ingest(segs)
+    assert np.array_equal(np.asarray(got["tags"]),
+                          np.asarray(want["tags"]))
+    # the rebatcher itself: 6 rows into 4+2
+    sizes = [c.shape[0] for c in _rebatch(iter(pieces), 4)]
+    assert sizes == [4, 2]
+
+
+def test_stream_stats_export_through_engine_metrics():
+    pipe = make_pipe()
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        ing = StreamingIngest(pipe, 2, engine=eng)
+        for _ in ing.run(rnd((4, SEG), 40)):
+            pass
+        m = eng.stats_metrics()
+        assert m["cess_engine_stream_batches"] == 2
+        assert m["cess_engine_stream_segments"] == 4
+        assert m["cess_engine_stream_bytes_in"] == 4 * SEG
+        assert "cess_engine_stream_stall_frac" in m
+        snap = eng.stats_snapshot()
+        assert snap["streams"][0]["batches"] == 2
+    finally:
+        eng.close()
+
+
+def test_stream_rejects_bad_shapes():
+    pipe = make_pipe()
+    with pytest.raises(ValueError, match="batch"):
+        StreamingIngest(pipe, 0)
+    ing = StreamingIngest(pipe, 2)
+    with pytest.raises(ValueError, match="rows"):
+        list(ing.run(rnd((3, SEG), 1), fragment_ids=rnd((2, ROWS, 2), 2,
+                                                        np.uint32)))
+    with pytest.raises(ValueError, match="empty"):
+        ing.ingest(np.zeros((0, SEG), np.uint8))
+    # explicit ids demand an array source — a chunked/iterator source
+    # cannot line up with a pre-shaped id array (loud, not an opaque
+    # numpy coercion error)
+    segs = rnd((4, SEG), 3)
+    with pytest.raises(ValueError, match="array segment source"):
+        list(ing.run(iter([segs[:2], segs[2:]]),
+                     fragment_ids=rnd((4, ROWS, 2), 4, np.uint32)))
+
+
+def test_stream_detach_stops_metric_contribution():
+    """detach() removes the driver's counters from the engine's merged
+    gauges (idempotent); a second attached driver keeps reporting."""
+    pipe = make_pipe()
+    eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        a = StreamingIngest(pipe, 2, engine=eng)
+        for _ in a.run(rnd((2, SEG), 70)):
+            pass
+        b = StreamingIngest(pipe, 2, engine=eng)
+        for _ in b.run(rnd((4, SEG), 71)):
+            pass
+        assert eng.stats_metrics()["cess_engine_stream_batches"] == 3
+        a.detach()
+        a.detach()                                  # idempotent
+        assert eng.stats_metrics()["cess_engine_stream_batches"] == 2
+        b.detach()
+        assert "cess_engine_stream_batches" not in eng.stats_metrics()
+    finally:
+        eng.close()
+
+
+# -- sharded mesh stream entry ---------------------------------------------
+
+def test_sharded_stream_entry_matches_single_device():
+    from cess_tpu.parallel.mesh import make_mesh, stream_entry
+
+    byte = 2
+    frag = byte * 2 * 512                   # blocks % byte == 0
+    cfg = PipelineConfig(k=K, m=M, segment_size=K * frag)
+    pipe = StoragePipeline(cfg)
+    mesh = make_mesh(jax.devices()[:4], seg=2, byte=byte)
+    segs = rnd((6, K * frag), 50)
+    ing = StreamingIngest(pipe, 2, **stream_entry(pipe, mesh, 2))
+    out = ing.ingest(segs)
+    ref = pipe.forward(segs)                # single-device fused
+    assert np.array_equal(np.asarray(out["fragments"]),
+                          np.asarray(ref["fragments"]))
+    assert np.array_equal(np.asarray(out["tags"]),
+                          np.asarray(ref["tags"]))
+
+
+def test_sharded_stream_entry_pair_ids():
+    """pair_ids=True: explicit hash-pair ids shard correctly and match
+    the single-device fused path; the default arange ids are rejected
+    LOUDLY (no pair-shaped default exists)."""
+    from cess_tpu.parallel.mesh import make_mesh, stream_entry
+
+    byte = 2
+    frag = byte * 2 * 512
+    cfg = PipelineConfig(k=K, m=M, segment_size=K * frag)
+    pipe = StoragePipeline(cfg)
+    mesh = make_mesh(jax.devices()[:4], seg=2, byte=byte)
+    segs = rnd((4, K * frag), 51)
+    ing = StreamingIngest(pipe, 2,
+                          **stream_entry(pipe, mesh, 2, pair_ids=True))
+    with pytest.raises(ValueError, match="pair_ids=True"):
+        list(ing.run(segs))                 # default ids: no pair shape
+    ids = rnd((4, ROWS, 2), 52, np.uint32)
+    out = ing.ingest(segs, fragment_ids=ids)
+    ref = pipe.forward(segs, fragment_ids=ids)
+    assert np.array_equal(np.asarray(out["tags"]),
+                          np.asarray(ref["tags"]))
+
+
+def test_stream_device_array_source():
+    """A device-resident (jax.Array) source is fetched ONCE and
+    re-batched like a host array — never iterated row-by-row."""
+    pipe = make_pipe()
+    segs = rnd((5, SEG), 53)
+    dev = StreamingIngest(pipe, 2).ingest(jnp.asarray(segs))
+    host = StreamingIngest(pipe, 2).ingest(segs)
+    assert np.array_equal(np.asarray(dev["tags"]),
+                          np.asarray(host["tags"]))
+    sizes = [c.shape[0] for c in _rebatch(jnp.asarray(segs), 2)]
+    assert sizes == [2, 2, 1]
+
+
+def test_stream_run_validates_eagerly():
+    """run() raises at the CALL site, not at the consumer's first
+    next() — it is a validating method over an inner generator."""
+    pipe = make_pipe()
+    segs = rnd((4, SEG), 54)
+    with pytest.raises(ValueError, match="array segment source"):
+        StreamingIngest(pipe, 2).run(
+            iter([segs[:2], segs[2:]]),
+            fragment_ids=rnd((4, ROWS, 2), 55, np.uint32))
+
+
+# -- repair warm path -------------------------------------------------------
+
+def test_warm_reconstruct_bit_exact_and_cached():
+    codec = rs.TPUCodec(K, M, strategy="gather")
+    data = rnd((K, 512), 60)
+    coded = np.asarray(codec.encode(data))
+    surv = coded[[1, 2]]
+    prog = codec.warm_reconstruct((1, 2), (0,), surv.shape)
+    assert codec.warm_reconstruct((1, 2), (0,), surv.shape) is prog
+    rec = np.asarray(codec.reconstruct(surv, (1, 2), (0,)))
+    assert np.array_equal(rec[0], coded[0])
+    # non-warmed pattern still takes the jit path, same result
+    surv2 = coded[[0, 2]]
+    rec2 = np.asarray(codec.reconstruct(surv2, (0, 2), (1,)))
+    assert np.array_equal(rec2[0], coded[1])
+
+
+def test_engine_warm_repair_prepopulates_programs():
+    eng = make_engine(K, M, rs_backend="jax",
+                      policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        n = 256
+        eng.warm_repair([((1, 2), (0,))], n)
+        built = eng.stats_snapshot()["programs_built"]
+        assert built >= 1
+        data = rnd((1, K, n), 61)
+        coded = np.asarray(eng.codec.encode(data))
+        rec = eng.reconstruct(coded[:, [1, 2]], (1, 2), (0,))
+        assert np.array_equal(np.asarray(rec)[:, 0], coded[:, 0])
+        snap = eng.stats_snapshot()
+        # the restoral request hit the warmed program, not a compile
+        assert snap["programs_built"] == built
+        assert snap["programs_reused"] >= 1
+    finally:
+        eng.close()
+
+
+def test_miner_warm_restoral_smoke():
+    """warm_restoral enumerates the restoral patterns without error on
+    both the engine and the direct-codec path (the NumPy reference
+    codec is a documented no-op)."""
+    from cess_tpu.node.chain_spec import dev_spec
+    from cess_tpu.node.network import Node
+    from cess_tpu.node.offchain import MinerAgent
+
+    node = Node(dev_spec(), "warm-node", {})
+    pipe = make_pipe()
+    MinerAgent(node, "m1", [], pipe).warm_restoral()
+    eng = make_engine(K, M, rs_backend="jax",
+                      policy=AdmissionPolicy(max_delay=0.005))
+    try:
+        MinerAgent(node, "m2", [], pipe, engine=eng).warm_restoral()
+        assert eng.stats_snapshot()["programs_built"] >= ROWS
+    finally:
+        eng.close()
