@@ -334,6 +334,41 @@ def bench_stream(jnp, jax, batch, n_segments, seg_size):
     return n_segments * seg_size / 2**30 / dt, st
 
 
+def bench_degraded(jnp, jax, batch, seg_size):
+    """degraded_encode_GiBps: engine encode throughput with the
+    resilience breaker FORCED OPEN — every batch transparently serves
+    on the CPU reference codec (cess_tpu/resilience health gate). The
+    number exists to pin two claims in CI, not to be fast: degraded
+    throughput is finite (the node keeps serving through a dead device
+    path), and degraded results are BIT-IDENTICAL to the device path
+    (asserted here on every run). Small fixed shape on purpose: the
+    CPU reference is the floor being measured."""
+    from cess_tpu.resilience import ResilienceConfig
+    from cess_tpu.serve import AdmissionPolicy, make_engine
+
+    k, m = 4, 8
+    res = ResilienceConfig()
+    eng = make_engine(k, m, rs_backend="jax", resilience=res,
+                      policy=AdmissionPolicy(max_delay=0.002))
+    try:
+        rng = np.random.default_rng(13)
+        data = rng.integers(0, 256, (batch, k, seg_size // k),
+                            dtype=np.uint8)
+        healthy = np.asarray(eng.encode(data, timeout=120))
+        eng.monitors["codec"].force_open()
+        t0 = time.perf_counter()
+        degraded = np.asarray(eng.encode(data, timeout=120))
+        dt = time.perf_counter() - t0
+        assert np.array_equal(degraded, healthy), \
+            "degraded-mode results diverged from the device path"
+        snap = res.stats.snapshot()
+        assert snap["degraded_batches"].get("encode", 0) >= 1, \
+            "breaker forced open but the batch did not degrade"
+        return batch * seg_size / 2**30 / dt
+    finally:
+        eng.close()
+
+
 def bench_podr2(jnp, jax, resident, frag_size, total, verify_chunk):
     """Tag-gen + challenge-verify throughput (fragments/s) over a
     ``total``-fragment workload (config 4: 100k fragments).
@@ -429,9 +464,10 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
-                         "stream,encode")
+                         "stream,degraded,encode")
     args = ap.parse_args()
-    known = {"decode", "speedup", "repair", "podr2", "stream", "encode"}
+    known = {"decode", "speedup", "repair", "podr2", "stream",
+             "degraded", "encode"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -530,6 +566,17 @@ def main() -> None:
                     "the double-buffered streaming driver (one "
                     "device_put per batch, staging overlapped with "
                     "compute, ragged tail included)")
+
+    if "degraded" in which:
+        # always the small CPU-safe shape: this measures the breaker-
+        # open CPU floor, and asserts degraded == device bit-for-bit
+        v = bench_degraded(jnp, jax, 2, 256 * 2**10)
+        emit("degraded_encode_GiBps", v, "GiB/s", v / 12.0,
+             bit_identical=True,
+             method="engine encode with the resilience breaker forced "
+                    "open (cess_tpu/resilience): batches serve on the "
+                    "CPU reference codec; results asserted equal to "
+                    "the device path before the number is emitted")
 
     if "encode" in which:
         emit("rs_4p8_encode_GiBps_per_chip", encode_gibps, "GiB/s",

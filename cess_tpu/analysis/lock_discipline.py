@@ -276,7 +276,11 @@ def _classes(mod: ParsedModule) -> list[_ClassLocks]:
 class _NodeRule(Rule):
     def applies(self, path: str) -> bool:
         parts = path_parts(path)
-        return "serve" in parts or "node" in parts
+        # resilience/ joined in ISSUE 4: HealthMonitor windows and
+        # ResilienceStats counters are touched from batcher AND
+        # submitter threads — exactly this family's territory
+        return "serve" in parts or "node" in parts \
+            or "resilience" in parts
 
 
 @register

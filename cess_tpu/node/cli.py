@@ -107,6 +107,22 @@ def main(argv=None) -> int:
                          "the cess_engineStats RPC. 'off' (default) "
                          "keeps every caller on the direct synchronous "
                          "path")
+    ap.add_argument("--resilience", default="off",
+                    choices=["off", "on"],
+                    help="attach the resilience layer "
+                         "(cess_tpu/resilience) to the --engine: "
+                         "saturated submits retry with deterministic "
+                         "backoff inside the request's deadline "
+                         "budget, a failed coalesced batch re-runs "
+                         "its members individually (one poisoned "
+                         "request cannot fail its batch-mates), and a "
+                         "per-backend health breaker transparently "
+                         "degrades device->CPU reference codec "
+                         "(bit-identical results) with recovery "
+                         "probes. Counters appear under "
+                         "cess_resilience_* beside the cess_engine_* "
+                         "family. Requires --engine; 'off' (default) "
+                         "keeps the engine fail-fast")
     args = ap.parse_args(argv)
 
     def unhex(s: str) -> bytes:
@@ -277,14 +293,26 @@ def _make_cli_engine(args, spec):
     own engine via serve.make_engine(podr2_key=...)). The CLI itself
     spawns no storage agents, so with a bare node the flag's visible
     effect is the stats surface: counters on GET /metrics
-    (cess_engine_*) and the cess_engineStats RPC."""
+    (cess_engine_*) and the cess_engineStats RPC.
+
+    --resilience mirrors the shape: opt-in, wraps THIS engine with
+    the retry/isolation/degradation layer (cess_tpu/resilience) and
+    adds the cess_resilience_* counters to the same surfaces."""
     if args.engine == "off":
+        if args.resilience != "off":
+            raise SystemExit("--resilience requires --engine "
+                             "(it wraps the submission engine)")
         return None
     from ..serve import make_engine
 
+    resilience = None
+    if args.resilience == "on":
+        from ..resilience import ResilienceConfig
+
+        resilience = ResilienceConfig()
     k = max(spec.fragment_count - 1, 1)      # reference RS(k, 1) shape
     return make_engine(k, spec.fragment_count - k,
-                       rs_backend=args.engine)
+                       rs_backend=args.engine, resilience=resilience)
 
 
 def _data_dir(args, spec) -> "str | None":

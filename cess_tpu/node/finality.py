@@ -67,6 +67,51 @@ class FinalityGadget:
     # and starve them of this voter forever (each voter votes a round
     # at most once). Vote a bounded tail of rounds instead.
     VOTE_TAIL = 32
+    # own-vote lock liveness backstop: a voter locked to a reorged-away
+    # branch (see _locked) abstains at most this many rounds before
+    # resuming — healing re-gossip normally releases it much sooner by
+    # proving the old target can no longer reach 2/3
+    LOCK_HORIZON = 32
+
+    def _quorum_impossible(self, rnd: int, target_hash: bytes) -> bool:
+        """True when ``target_hash`` can provably never reach 2/3 in
+        round ``rnd``: enough OTHER signed round-``rnd`` votes have
+        been observed that the remaining voters cannot complete a
+        quorum. Votes are one-per-voter-per-round (equivocations are
+        slashable evidence, not counted), so observed contrary votes
+        bound the target's possible support globally, not just in our
+        view — the GRANDPA release argument."""
+        node = self.node
+        others = sum(len(votes) for h, votes
+                     in self._tally.get(rnd, {}).items()
+                     if h != target_hash)
+        n_auth = len(node.authorities)
+        return 3 * (n_auth - others) < 2 * n_auth
+
+    def _locked(self, account: str, head_number: int) -> bool:
+        """The GRANDPA-style own-vote lock: having voted round r for a
+        block we since reorged AWAY from, we must not vote later
+        rounds on the new branch while that old vote could still
+        complete a 2/3 quorum elsewhere — two conflicting
+        justifications assembled from partial vote views are exactly
+        how replicas diverge irrecoverably (the one-phase gadget's
+        unsafe window; root cause of the chain-topology discovery
+        flake). The lock releases when the old vote finalizes, its
+        branch regains canonicity, a quorum on it becomes provably
+        impossible (healing re-gossip supplies the contrary votes), or
+        the LOCK_HORIZON liveness backstop passes."""
+        node = self.node
+        for rnd, votes in self._first.items():
+            v = votes.get(account)
+            if v is None or rnd <= node.finalized:
+                continue
+            if node._is_canonical(v.target_hash):
+                continue
+            if head_number - rnd > self.LOCK_HORIZON:
+                continue
+            if not self._quorum_impossible(rnd, v.target_hash):
+                return True
+        return False
 
     def vote_jobs(self) -> list[tuple]:
         """Collect the (account, key, round, target_hash) tuples this
@@ -88,11 +133,12 @@ class FinalityGadget:
         if head.number <= node.finalized:
             return jobs
         lo = max(node.finalized + 1, head.number - self.VOTE_TAIL + 1)
+        voters = [(a, k) for a, k in node.keystore.items()
+                  if a in node.authorities
+                  and not self._locked(a, head.number)]
         for rnd in range(lo, head.number + 1):
             target = node.chain[rnd]
-            for account, key in node.keystore.items():
-                if account not in node.authorities:
-                    continue
+            for account, key in voters:
                 if account in self._first.get(rnd, {}) \
                         or (rnd, account) in self._signing:
                     continue   # never double-vote (that's equivocation)
@@ -130,6 +176,61 @@ class FinalityGadget:
         votes = self.sign_jobs(self.vote_jobs())
         self.ingest_own(votes)
         return votes
+
+    # -- healing -----------------------------------------------------------
+    # Gossip is fire-and-forget and sync re-fetches BLOCKS, never
+    # votes: a vote relayed into a partially-formed mesh is lost
+    # forever, which both stalls finality and (combined with reorgs)
+    # opens the conflicting-quorum window _locked guards against. The
+    # transports therefore re-offer this state every round; receivers
+    # dedup, so repetition costs bytes, not correctness.
+    def own_unfinalized_votes(self, limit: int = 8) -> list[Vote]:
+        """This node's own signed votes for the newest ``limit``
+        unfinalized rounds — the re-gossip set. Caller holds the node
+        lock."""
+        node = self.node
+        out: list[Vote] = []
+        for rnd in sorted(self._first, reverse=True):
+            if rnd <= node.finalized:
+                continue
+            for account in node.keystore:
+                v = self._first[rnd].get(account)
+                if v is not None:
+                    out.append(v)
+            if len(out) >= limit:
+                break
+        return out
+
+    def newest_justification(self) -> Justification | None:
+        """The highest-round justification held (older rounds are
+        pruned — finality is ancestor-transitive)."""
+        if not self.justifications:
+            return None
+        return self.justifications[max(self.justifications)]
+
+    def apply_pending(self) -> None:
+        """Re-apply stored justifications whose target block has since
+        been imported. A justification can arrive BEFORE its block:
+        on_justification skips unknown headers, and _try_finalize's
+        round-dedup then never re-fires for that round — without this
+        sweep the node holds a valid proof of finality it never acts
+        on. Caller holds the node lock.
+
+        Also prunes superseded rounds afterwards: peer-sync nodes
+        accumulate justifications through the "just" handler without
+        ever reaching _try_finalize's prune (they assemble no local
+        quorum), and finality is ancestor-transitive, so only the
+        newest round needs retaining — the same O(1) retention the
+        vote path keeps."""
+        for rnd in sorted(self.justifications):
+            j = self.justifications[rnd]
+            if j.target_number > self.node.finalized \
+                    and j.target_hash in self.node.headers:
+                self.node.on_justification(j)
+        if self.justifications:
+            newest = max(self.justifications)
+            for r in [r for r in self.justifications if r < newest]:
+                del self.justifications[r]
 
     # -- incoming ----------------------------------------------------------
     def on_vote(self, vote: Vote) -> None:

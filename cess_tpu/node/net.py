@@ -66,6 +66,7 @@ import time
 from .. import codec
 from ..chain.state import DispatchError
 from ..crypto import ed25519
+from ..resilience import faults
 from . import dht as dht_mod
 
 _LEN = struct.Struct("<I")
@@ -181,8 +182,14 @@ class NodeService:
                  host: str = "127.0.0.1", slot_time: float = 0.2,
                  genesis_time: float = 0.0,
                  faults: FaultPolicy | None = None,
-                 degree: int = 8):
+                 degree: int = 8, discovery_interval: float = 0.25):
         self.node = node
+        # discovery runs as its OWN schedulable loop at this cadence
+        # (not piggybacked on authoring slots): mesh formation then
+        # converges in a bounded number of rounds regardless of slot
+        # timing or host load — the seam the deterministic chain-
+        # topology test (tests/test_net.py) drives
+        self.discovery_interval = discovery_interval
         # all processes must agree on slot numbering (slot is signed
         # into VRF claims and drives epoch derivation): slots count
         # from a SHARED genesis wall-clock instant, not process start
@@ -247,6 +254,7 @@ class NodeService:
         self._spawn(self._dht_accept_loop, dsrv)
         self._spawn(self._accept_loop, srv)
         self._redial()
+        self._spawn(self._discovery_loop)
         self._spawn(self._author_loop)
 
     def _dial_targets(self) -> list[int]:
@@ -294,6 +302,22 @@ class NodeService:
                     continue
                 self._known_peers.add(p)
         self._redial()
+
+    def _discovery_loop(self) -> None:
+        """The discovery round, on its own schedulable cadence: sweep
+        dead-peer coolings + re-dial ring targets, and RE-ADVERTISE the
+        known peer set on every live connection. Peer exchange is
+        idempotent (receivers cap + dedup), so repetition turns mesh
+        formation from a race against connection setup into a bounded
+        number of deterministic rounds — a frame lost while a link was
+        half-up is re-offered next round."""
+        while not self._stop.wait(self.discovery_interval):
+            self._redial()
+            with self.lock:
+                known = (self.port, *sorted(self._known_peers))
+            for conn in list(self.conns):
+                if conn.alive:
+                    self._send(conn, ("peers", known))
 
     def stop(self) -> None:
         self._stop.set()
@@ -415,6 +439,8 @@ class NodeService:
     def _send(self, conn: _Conn, msg) -> None:
         if self.faults is not None and not self.faults.allow():
             return
+        if not faults.allow("net.send"):
+            return   # seeded chaos drop (cess_tpu/resilience/faults.py)
         self.msgs_sent += 1
         conn.send(codec.encode(msg))
 
@@ -437,6 +463,8 @@ class NodeService:
             if conn.alive:
                 if self.faults is not None and not self.faults.allow():
                     continue
+                if not faults.allow("net.send"):
+                    continue   # seeded chaos drop, per conn like faults
                 self.msgs_sent += 1
                 conn.send(raw)
 
@@ -496,6 +524,7 @@ class NodeService:
         elif kind == "status":
             peer_head, _, peer_fin = payload
             now = time.time()
+            offer_just = None
             with self.lock:
                 ours = self.node.head().number
                 warp_viable = (ours == 0 and peer_fin > WARP_THRESHOLD
@@ -506,6 +535,14 @@ class NodeService:
                     # tick — a large snapshot takes time to arrive
                     self._warp_tries += 1
                     self._warp_backoff = now + 1.0
+                if peer_fin < self.node.finalized:
+                    # finality healing, pull side: a peer behind on
+                    # finality gets our newest justification directly
+                    # (it finalizes ancestors transitively)
+                    offer_just = \
+                        self.node.finality.newest_justification()
+            if offer_just is not None:
+                self._send(conn, ("just", offer_just))
             if fire_warp:
                 # fresh node far behind a finalized peer: checkpoint
                 # sync instead of replaying the whole chain; bounded
@@ -601,6 +638,9 @@ class NodeService:
         pure-python signatures after a sync batch must not stall
         recv/RPC/authoring)."""
         with self.lock:
+            # a justification may have arrived before its block did;
+            # now that the chain moved, act on any that became usable
+            self.node.finality.apply_pending()
             jobs = self.node.finality.vote_jobs()
         votes = self.node.finality.sign_jobs(jobs)
         with self.lock:
@@ -650,9 +690,22 @@ class NodeService:
             for conn in list(self.conns):
                 if conn.alive:
                     self._send_status(conn)
-            # periodic re-dial sweep: expired coolings rejoin the ring,
-            # ring changes from discovery get their dial loops
-            self._redial()
+            # finality healing: gossip is fire-and-forget and sync
+            # re-fetches blocks, never votes — a vote relayed into a
+            # partially-formed mesh is lost forever, which stalls
+            # finality and feeds the conflicting-quorum window the
+            # vote lock (finality._locked) guards. Re-offer own
+            # unfinalized votes + the newest justification each slot;
+            # receivers dedup, so repetition costs bytes only.
+            with self.lock:
+                own_votes = self.node.finality.own_unfinalized_votes()
+                newest_just = self.node.finality.newest_justification()
+                fin = self.node.finalized
+            for v in own_votes:
+                self.broadcast(("vote", v), mark_seen=False)
+            if newest_just is not None \
+                    and newest_just.target_number >= fin:
+                self.broadcast(("just", newest_just), mark_seen=False)
             # periodic authority-record publication, off this thread
             # (publication does blocking DHT RPCs; authoring must not)
             now = time.time()

@@ -633,10 +633,17 @@ class Network:
     def exchange_votes(self) -> None:
         """The GRANDPA-gossip analog: every node casts signed votes
         for its best chain and every vote reaches every node; each
-        node tallies + finalizes independently."""
+        node tallies + finalizes independently.
+
+        Each node also RE-SHARES its own unfinalized votes (receivers
+        dedup first-seen): nodes re-joined after a partition would
+        otherwise never learn the other side's round votes, leaving
+        own-vote locks (finality._locked) un-releasable and finality
+        needlessly stalled until the lock horizon."""
         votes = []
         for node in self.nodes:
             votes.extend(node.finality.cast_votes())
+            votes.extend(node.finality.own_unfinalized_votes())
         for node in self.nodes:
             for v in votes:
                 node.finality.on_vote(v)

@@ -35,11 +35,13 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import time
 
 import jax.numpy as jnp
 import numpy as np
 
 from .. import codec, constants
+from ..resilience import faults
 from ..chain.file_bank import UserBrief
 from ..chain.state import DispatchError
 from ..crypto import bls12381
@@ -164,11 +166,16 @@ def slow_filler_bytes(secret: bytes, index: int, size: int,
 
 class MinerAgent:
     def __init__(self, node: Node, account: str, gateways: list[OssGateway],
-                 pipeline: StoragePipeline, engine=None):
+                 pipeline: StoragePipeline, engine=None, retry=None):
         self.node = node
         self.account = account
         self.gateways = gateways
         self.pipeline = pipeline
+        # optional cess_tpu.resilience.RetryPolicy for fragment
+        # transfers: dropped/corrupted fetches (the "offchain.fetch"
+        # fault seam) re-attempt with deterministic backoff instead of
+        # waiting a whole deal-servicing round. None = one attempt.
+        self.retry = retry
         # optional submission engine (cess_tpu/serve): proving and RS
         # repair go through its prove/repair queues — concurrent miners
         # answering the same round coalesce into shared device batches.
@@ -228,7 +235,7 @@ class MinerAgent:
     # -- deal servicing ---------------------------------------------------------
     def _fetch(self, frag_hash: bytes) -> bool:
         for gw in self.gateways:
-            blob = gw.fragment_store.get(frag_hash)
+            blob = self._transfer(gw, frag_hash)
             if blob is not None:
                 self.store[frag_hash] = blob
                 self.tags[frag_hash] = gw.tag_store[frag_hash]
@@ -236,6 +243,32 @@ class MinerAgent:
         # repair path: reconstruct from peers (restoral flow fetches
         # survivor rows from other miners via the network harness)
         return False
+
+    def _transfer(self, gw: OssGateway, frag_hash: bytes) -> bytes | None:
+        """One gateway fragment transfer: faultable (seam
+        "offchain.fetch" drops the transfer, "offchain.fetch_bytes"
+        corrupts the payload), INTEGRITY-CHECKED against the on-chain
+        fragment hash (a corrupted transfer is a failed transfer,
+        never poisoned storage — the same contract try_repair applies
+        to reconstructed bytes), and retried under the configured
+        policy. Returns the verified bytes or None."""
+        attempts = 1 if self.retry is None else self.retry.max_attempts
+        for attempt in range(1, attempts + 1):
+            if attempt > 1:
+                # deterministic jitter keyed by the fragment identity:
+                # replayable in chaos tests, decorrelated across frags
+                time.sleep(self.retry.delay_for(attempt - 1,
+                                                token=frag_hash))
+            if not faults.allow("offchain.fetch"):
+                continue             # transfer dropped: transient
+            blob = gw.fragment_store.get(frag_hash)
+            if blob is None:
+                return None          # gateway lacks it: not transient
+            blob = faults.corrupt("offchain.fetch_bytes", blob)
+            if fragment_hash(blob) == frag_hash:
+                return blob
+            # corrupted in flight: counts as a failed attempt
+        return None
 
     def on_block(self, node: Node) -> None:
         rt = node.runtime

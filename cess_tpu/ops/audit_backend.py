@@ -15,26 +15,34 @@ import functools
 
 import jax
 
+from ..resilience import faults
 from . import podr2
 
 
 class AuditBackend:
     """Batched PoDR2 surface bound to one device: tag generation
     (TEE role), challenge derivation, proving (miner role, aggregated
-    constant-size proofs), verification (TEE role)."""
+    constant-size proofs), verification (TEE role).
+
+    Fault seams (cess_tpu/resilience): ``podr2.<op>.<platform>`` —
+    the platform suffix lets a chaos plan fail the accelerator-bound
+    gate while the CPU instance (the resilience layer's degradation
+    target) stays clean."""
 
     def __init__(self, key: podr2.Podr2Key, device):
         self.key = key
         self.device = device
+        self._site = f"podr2.{{}}.{device.platform}"
 
-    def _on(self, fn, *args):
+    def _on(self, op: str, fn, *args):
+        faults.inject(self._site.format(op))
         with jax.default_device(self.device):
             return fn(*args)
 
     # -- TEE: tag generation ------------------------------------------------
     def tag_fragments(self, fragment_ids, fragments):
-        return self._on(podr2.tag_fragments, self.key, fragment_ids,
-                        fragments)
+        return self._on("tag", podr2.tag_fragments, self.key,
+                        fragment_ids, fragments)
 
     # -- round: challenge derivation ----------------------------------------
     def gen_challenge(self, seed: bytes, num_blocks: int,
@@ -44,23 +52,26 @@ class AuditBackend:
 
     # -- miner: proving ------------------------------------------------------
     def prove_batch(self, fragments, tags, idx, nu):
-        return self._on(podr2.prove_batch, fragments, tags, idx, nu)
+        return self._on("prove", podr2.prove_batch, fragments, tags,
+                        idx, nu)
 
     def prove_aggregate(self, fragments, tags, idx, nu, r):
-        return self._on(podr2.prove_aggregate, fragments, tags, idx, nu, r)
+        return self._on("prove", podr2.prove_aggregate, fragments, tags,
+                        idx, nu, r)
 
     def aggregate_coeffs(self, seed: bytes, fragment_ids):
-        return self._on(podr2.aggregate_coeffs, seed, fragment_ids)
+        return self._on("prove", podr2.aggregate_coeffs, seed,
+                        fragment_ids)
 
     # -- TEE: verification ---------------------------------------------------
     def verify_batch(self, fragment_ids, num_blocks, idx, nu, mu, sigma):
-        return self._on(podr2.verify_batch, self.key, fragment_ids,
-                        num_blocks, idx, nu, mu, sigma)
+        return self._on("verify", podr2.verify_batch, self.key,
+                        fragment_ids, num_blocks, idx, nu, mu, sigma)
 
     def verify_aggregate(self, fragment_ids, num_blocks, idx, nu, r, mu,
                          sigma):
-        return self._on(podr2.verify_aggregate, self.key, fragment_ids,
-                        num_blocks, idx, nu, r, mu, sigma)
+        return self._on("verify", podr2.verify_aggregate, self.key,
+                        fragment_ids, num_blocks, idx, nu, r, mu, sigma)
 
 
 @functools.lru_cache(maxsize=None)

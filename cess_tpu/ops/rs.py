@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience import faults
 from . import gf
 
 Strategy = str  # "gather" | "bitmatrix" | "pallas" (fused bitmatrix, TPU default)
@@ -202,7 +203,13 @@ class TPUCodec:
         return self._parity_apply(jnp.asarray(data, dtype=jnp.uint8))
 
     def encode(self, data: jax.Array) -> jax.Array:
-        """[..., k, n] -> [..., k+m, n] coded shards (systematic)."""
+        """[..., k, n] -> [..., k+m, n] coded shards (systematic).
+
+        Fault seam ``rs.encode`` (cess_tpu/resilience): hooks sit on
+        the DEVICE codec only — the CPU ReferenceCodec stays
+        injection-free, so a chaos plan failing the device path leaves
+        the breaker's fallback clean."""
+        faults.inject("rs.encode")
         data = jnp.asarray(data, dtype=jnp.uint8)
         if data.shape[-2] != self.k:
             raise ValueError(f"expected {self.k} data shards, got {data.shape[-2]}")
@@ -252,6 +259,7 @@ class TPUCodec:
         Dispatches a pre-compiled executable when the exact
         (pattern, shape) has been warmed (see warm_reconstruct).
         """
+        faults.inject("rs.reconstruct")
         present = tuple(present)
         if missing is None:
             missing = tuple(i for i in range(self.k + self.m) if i not in present)
@@ -266,6 +274,7 @@ class TPUCodec:
 
     def decode_data(self, survivors: jax.Array, present: tuple[int, ...]) -> jax.Array:
         """Recover the k data shards from any k survivors."""
+        faults.inject("rs.decode")
         apply_ = self._matrix_for("decode", tuple(present))
         return apply_(jnp.asarray(survivors, dtype=jnp.uint8))
 

@@ -1,0 +1,49 @@
+"""cess_tpu.resilience — fault tolerance for the serving data plane.
+
+Four parts, one theme: the stack that audits OTHER people's storage
+faults must survive its own. See each module for the full design:
+
+- faults.py   deterministic fault injector: a seeded FaultPlan fires
+              raise/delay/drop/corrupt actions at named sites threaded
+              through the hot-path seams (engine dispatch, stream
+              staging, codec gates, fragment transfer, peer
+              messaging); zero-cost no-ops when nothing is armed, and
+              same seed => bit-identical schedule, so chaos tests run
+              in tier-1.
+- retry.py    RetryPolicy (exponential backoff + deterministic
+              jitter) and Budget (deadline propagation: each attempt
+              spends from the request's ONE remaining-time pool).
+- health.py   HealthMonitor (sliding-window error rates, count-based
+              recovery probes) + the breaker-gated device->CPU
+              degradation config; CPU results are bit-identical by
+              construction, so degradation changes latency only.
+- stats.py    cess_resilience_* counters, merged into the engine's
+              GET /metrics exposition next to cess_engine_*.
+
+Wire-up: ``serve.make_engine(..., resilience=ResilienceConfig())`` or
+``node.cli --resilience`` (mirrors ``--engine``); everything stays
+opt-in — without a config the engine behaves exactly as before.
+"""
+from .faults import (FaultInjected, FaultPlan, FaultSpec, allow, arm,
+                     armed, armed_plan, corrupt, disarm, inject)
+from .health import HealthMonitor, ResilienceConfig
+from .retry import Budget, RetryPolicy
+from .stats import ResilienceStats
+
+__all__ = [
+    "Budget",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "HealthMonitor",
+    "ResilienceConfig",
+    "ResilienceStats",
+    "RetryPolicy",
+    "allow",
+    "arm",
+    "armed",
+    "armed_plan",
+    "corrupt",
+    "disarm",
+    "inject",
+]

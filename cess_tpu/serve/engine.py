@@ -43,6 +43,23 @@ The direct synchronous path remains the default everywhere (the
 trait-gate philosophy): an engine is used only where one is explicitly
 configured (StoragePipeline(engine=...), MinerAgent(engine=...),
 TeeAgent(engine=...), ``node.cli --engine``).
+
+Resilience (opt-in, cess_tpu/resilience): constructed with a
+``ResilienceConfig`` the engine additionally
+- retries saturated blocking submits with deterministic backoff inside
+  the request's ONE deadline budget (retry.py);
+- isolates batch failures — a device error against a coalesced batch
+  re-runs the members individually once, so a poisoned request cannot
+  fail its batch-mates (``cess_resilience_batch_requeues``);
+- health-gates each backend: a breaker tripped by the error window
+  transparently serves batches on the CPU reference codec/audit
+  backend (bit-identical results by construction) and probes its way
+  back (health.py);
+- exposes it all as ``cess_resilience_*`` gauges beside the
+  ``cess_engine_*`` family.
+The ``engine.dispatch`` fault site (resilience/faults.py) sits on
+every non-degraded device attempt, so seeded chaos plans can drive
+all of the above deterministically in tier-1.
 """
 from __future__ import annotations
 
@@ -57,6 +74,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience import faults
+from ..resilience.retry import Budget
 from .buckets import ProgramCache, bucket_rows
 from .policy import (CLASSES, AdmissionPolicy, EngineClosed,
                      EngineSaturated, EngineTimeout)
@@ -154,8 +173,14 @@ class SubmissionEngine:
     an ``ErasureCodec`` (ops/rs.py gate) and optionally an
     ``AuditBackend`` (ops/audit_backend.py gate) directly."""
 
+    # op class -> which backend's health breaker gates it
+    _BACKEND_OF = {"encode": "codec", "repair": "codec", "decode": "codec",
+                   "tag": "audit", "verify_batch": "audit",
+                   "verify_agg": "audit", "prove": "audit"}
+
     def __init__(self, codec=None, audit=None,
-                 policy: AdmissionPolicy | None = None):
+                 policy: AdmissionPolicy | None = None,
+                 resilience=None):
         if codec is None and audit is None:
             raise ValueError("engine needs a codec and/or audit backend")
         self.codec = codec
@@ -163,6 +188,29 @@ class SubmissionEngine:
         self.policy = policy or AdmissionPolicy()
         self.stats = EngineStats()
         self.programs = ProgramCache(self.stats)
+        # resilience (cess_tpu/resilience, opt-in): CPU reference
+        # fallbacks compute bit-identical bytes, so a tripped breaker
+        # changes WHERE a batch runs, never what it returns
+        self.resilience = resilience
+        self.monitors: dict[str, Any] = {}
+        self._fallback_codec = None
+        self._fallback_audit = None
+        if resilience is not None:
+            self.stats.resilience = resilience.stats
+            if codec is not None:
+                from ..ops import rs as _rs
+
+                self._fallback_codec = _rs.make_codec(codec.k, codec.m,
+                                                      backend="cpu")
+                self.monitors["codec"] = resilience.monitor()
+            if audit is not None:
+                from ..ops import audit_backend as _ab
+
+                self._fallback_audit = _ab.make_audit_backend(audit.key,
+                                                              "cpu")
+                self.monitors["audit"] = resilience.monitor()
+            for name, mon in self.monitors.items():
+                resilience.stats.register_monitor(name, mon)
         self._queues: dict[str, collections.deque[_Request]] = {
             c: collections.deque() for c in CLASSES}
         self._lock = threading.Lock()
@@ -189,7 +237,8 @@ class SubmissionEngine:
                             {"data": data}, {}, timeout, squeeze)
 
     def encode(self, data, timeout: float | None = None) -> np.ndarray:
-        return self.submit_encode(data, timeout).result()
+        return self._blocking("encode", self.submit_encode, data,
+                              timeout=timeout)
 
     # -- decode / repair (ErasureCodec) --------------------------------
     def submit_reconstruct(self, survivors, present, missing=None,
@@ -211,8 +260,9 @@ class SubmissionEngine:
 
     def reconstruct(self, survivors, present, missing=None,
                     timeout: float | None = None) -> np.ndarray:
-        return self.submit_reconstruct(survivors, present, missing,
-                                       timeout).result()
+        return self._blocking("repair", self.submit_reconstruct,
+                              survivors, present, missing,
+                              timeout=timeout)
 
     def submit_decode_data(self, survivors, present,
                            timeout: float | None = None) -> EngineFuture:
@@ -226,8 +276,8 @@ class SubmissionEngine:
 
     def decode_data(self, survivors, present,
                     timeout: float | None = None) -> np.ndarray:
-        return self.submit_decode_data(survivors, present,
-                                       timeout).result()
+        return self._blocking("repair", self.submit_decode_data,
+                              survivors, present, timeout=timeout)
 
     # -- tag (AuditBackend, TEE role) ----------------------------------
     def submit_tag(self, fragment_ids, fragments,
@@ -246,7 +296,8 @@ class SubmissionEngine:
 
     def tag_fragments(self, fragment_ids, fragments,
                       timeout: float | None = None) -> np.ndarray:
-        return self.submit_tag(fragment_ids, fragments, timeout).result()
+        return self._blocking("tag", self.submit_tag, fragment_ids,
+                              fragments, timeout=timeout)
 
     # -- prove (miner role) --------------------------------------------
     def submit_prove_aggregate(self, fragments, tags, idx, nu, r,
@@ -283,8 +334,9 @@ class SubmissionEngine:
     def prove_aggregate(self, fragments, tags, idx, nu, r,
                         sectors: int | None = None,
                         timeout: float | None = None):
-        return self.submit_prove_aggregate(fragments, tags, idx, nu, r,
-                                           sectors, timeout).result()
+        return self._blocking("prove", self.submit_prove_aggregate,
+                              fragments, tags, idx, nu, r, sectors,
+                              timeout=timeout)
 
     # -- verify (TEE role) ---------------------------------------------
     def submit_verify_batch(self, fragment_ids, num_blocks, idx, nu,
@@ -312,8 +364,9 @@ class SubmissionEngine:
 
     def verify_batch(self, fragment_ids, num_blocks, idx, nu, mu, sigma,
                      timeout: float | None = None) -> np.ndarray:
-        return self.submit_verify_batch(fragment_ids, num_blocks, idx,
-                                        nu, mu, sigma, timeout).result()
+        return self._blocking("verify", self.submit_verify_batch,
+                              fragment_ids, num_blocks, idx, nu, mu,
+                              sigma, timeout=timeout)
 
     def submit_verify_aggregate(self, fragment_ids, num_blocks, idx, nu,
                                 r, mu, sigma,
@@ -345,9 +398,9 @@ class SubmissionEngine:
 
     def verify_aggregate(self, fragment_ids, num_blocks, idx, nu, r, mu,
                          sigma, timeout: float | None = None) -> bool:
-        return bool(self.submit_verify_aggregate(
-            fragment_ids, num_blocks, idx, nu, r, mu, sigma,
-            timeout).result())
+        return bool(self._blocking(
+            "verify", self.submit_verify_aggregate, fragment_ids,
+            num_blocks, idx, nu, r, mu, sigma, timeout=timeout))
 
     # ------------------------------------------------------------------
     # lifecycle / introspection
@@ -466,6 +519,29 @@ class SubmissionEngine:
     def _need_audit(self) -> None:
         if self.audit is None:
             raise ValueError("engine has no AuditBackend configured")
+
+    def _blocking(self, cls: str, submit, *args,
+                  timeout: float | None = None):
+        """The blocking convenience form behind encode()/tag_fragments()
+        /... — without resilience it is submit().result() verbatim.
+        With it, EngineSaturated submits retry under the configured
+        backoff policy inside ONE deadline budget: every attempt's
+        queue deadline and wait are the budget's REMAINING time, so
+        retrying can never extend the caller's deadline."""
+        res = self.resilience
+        if res is None:
+            return submit(*args, timeout=timeout).result()
+        if timeout is None:
+            timeout = self.policy.default_timeout
+        budget = Budget(timeout)
+
+        def attempt(b):
+            left = b.remaining()
+            return submit(*args, timeout=left).result(left)
+
+        return res.retry.call(attempt, retry_on=(EngineSaturated,),
+                              budget=budget, token=cls,
+                              stats=res.stats, cls=cls)
 
     @staticmethod
     def _norm_shards(data, rows: int):
@@ -631,17 +707,41 @@ class SubmissionEngine:
     def _run_batch(self, batch: list[_Request]) -> None:
         cls = batch[0].cls
         op = batch[0].key[0]
+        runner: Callable = getattr(self, f"_op_{op}")
+        res = self.resilience
+        mon = self.monitors.get(self._BACKEND_OF.get(op))
+        # breaker open (and no probe due): serve on the CPU fallback
+        degraded = res is not None and res.fallback \
+            and mon is not None and not mon.allow()
+        if degraded:
+            res.stats.note_degraded(cls)
+        t0 = time.monotonic()
         try:
-            runner: Callable = getattr(self, f"_op_{op}")
-            results, device_rows = runner(batch)
-        except Exception as e:        # op failure: reject the batch
+            if not degraded:
+                faults.inject("engine.dispatch")   # chaos seam
+            results, device_rows = runner(batch, degraded)
+        except Exception as e:        # op failure
+            if mon is not None and not degraded:
+                mon.record_error()
+            if res is not None and self._salvage_batch(runner, batch, e,
+                                                       mon, degraded):
+                return
             with self._lock:
                 self.stats.classes[cls].failed += len(batch)
             for r in batch:
                 r.future._reject(e)
             return
+        if mon is not None and not degraded:
+            mon.record_success(time.monotonic() - t0)
+        self._account_batch(batch, device_rows)
+        for r, out in zip(batch, results):
+            r.future._resolve(out)
+
+    def _account_batch(self, batch: list[_Request],
+                       device_rows: int) -> None:
         done = time.monotonic()
         real_rows = sum(r.rows for r in batch)
+        cls = batch[0].cls
         with self._lock:
             st = self.stats.classes[cls]
             st.batches += 1
@@ -651,8 +751,58 @@ class SubmissionEngine:
             st.completed += len(batch)
             for r in batch:
                 st.latencies.append(done - r.enqueue_t)
-        for r, res in zip(batch, results):
-            r.future._resolve(res)
+
+    def _salvage_batch(self, runner: Callable, batch: list[_Request],
+                       primary_exc: BaseException, mon,
+                       degraded: bool) -> bool:
+        """A batch op failed with resilience configured: isolate the
+        members — re-run each ALONE once (one poisoned request must
+        not fail its batch-mates), then, if the device attempt failed
+        and fallback is allowed, serve the member on the CPU reference
+        backend. Resolves or rejects every future; returns True (the
+        caller is done with the batch)."""
+        res = self.resilience
+        cls = batch[0].cls
+        if len(batch) > 1:
+            res.stats.note_batch_requeues(len(batch))
+        # solo re-runs use the primary backend only while the breaker
+        # is closed (or the failed batch was already degraded): when
+        # the failure WAS a recovery probe against an open breaker,
+        # re-probing the known-bad device once per member would
+        # amplify the outage latency by the batch size — members go
+        # straight to the fallback instead
+        solo = len(batch) > 1 \
+            and (degraded or mon is None or mon.state == "closed")
+        for r in batch:
+            out = None
+            exc = primary_exc
+            if solo:
+                try:
+                    if not degraded:
+                        faults.inject("engine.dispatch")
+                    out, rows = runner([r], degraded)
+                except Exception as e:  # noqa: BLE001 — per-member isolation
+                    exc = e
+                    if mon is not None and not degraded:
+                        mon.record_error()
+                else:
+                    if mon is not None and not degraded:
+                        mon.record_success(0.0)
+            if out is None and not degraded and res.fallback \
+                    and mon is not None:
+                try:
+                    out, rows = runner([r], True)
+                    res.stats.note_fallback(cls)
+                except Exception as e:  # noqa: BLE001 — fallback is best-effort
+                    exc = e
+            if out is None:
+                with self._lock:
+                    self.stats.classes[cls].failed += 1
+                r.future._reject(exc)
+            else:
+                self._account_batch([r], rows)
+                r.future._resolve(out[0])
+        return True
 
     # -- op runners (batcher thread only) -------------------------------
     def _split_rows(self, batch: list[_Request], out) -> list:
@@ -682,17 +832,35 @@ class SubmissionEngine:
             off += r.rows
         return results
 
-    def _op_encode(self, batch):
+    def _rs_backend(self, degraded: bool):
+        """The ErasureCodec serving this batch: the configured device
+        gate, or the CPU reference when the breaker degraded it."""
+        return self._fallback_codec if degraded else self.codec
+
+    def _audit_backend(self, degraded: bool):
+        return self._fallback_audit if degraded else self.audit
+
+    @staticmethod
+    def _key(key: tuple, degraded: bool) -> tuple:
+        """Degraded programs cache under their own keys — a breaker
+        flip must never hand a device program a CPU batch or vice
+        versa."""
+        return key + ("cpu-fallback",) if degraded else key
+
+    def _op_encode(self, batch, degraded=False):
+        codec = self._rs_backend(degraded)
         data = _concat_rows([r.arrays["data"] for r in batch])
         total = data.shape[0]
         bucket = bucket_rows(total)
         _, k, n = data.shape
-        prog = self.programs.get(("encode", k, n, bucket),
-                                 lambda: self.codec.encode)
+        prog = self.programs.get(self._key(("encode", k, n, bucket),
+                                           degraded),
+                                 lambda: codec.encode)
         out = prog(_pad_axis0(data, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_repair(self, batch):
+    def _op_repair(self, batch, degraded=False):
+        codec = self._rs_backend(degraded)
         kind = batch[0].key[1]
         aux = batch[0].aux
         surv = _concat_rows([r.arrays["survivors"] for r in batch])
@@ -702,30 +870,34 @@ class SubmissionEngine:
         if kind == "reconstruct":
             present, missing = aux["present"], aux["missing"]
             prog = self.programs.get(
-                ("repair", present, missing, n, bucket),
-                lambda: (lambda a: self.codec.reconstruct(a, present,
-                                                          missing)))
+                self._key(("repair", present, missing, n, bucket),
+                          degraded),
+                lambda: (lambda a: codec.reconstruct(a, present,
+                                                     missing)))
         else:
             present = aux["present"]
             prog = self.programs.get(
-                ("decode", present, n, bucket),
-                lambda: (lambda a: self.codec.decode_data(a, present)))
+                self._key(("decode", present, n, bucket), degraded),
+                lambda: (lambda a: codec.decode_data(a, present)))
         out = prog(_pad_axis0(surv, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_tag(self, batch):
+    def _op_tag(self, batch, degraded=False):
+        audit = self._audit_backend(degraded)
         ids = _concat_rows([r.arrays["ids"] for r in batch])
         frags = _concat_rows([r.arrays["fragments"] for r in batch])
         total = frags.shape[0]
         bucket = bucket_rows(total)
         nbytes = frags.shape[1]
-        prog = self.programs.get(("tag", nbytes, bucket),
-                                 lambda: self.audit.tag_fragments)
+        prog = self.programs.get(self._key(("tag", nbytes, bucket),
+                                           degraded),
+                                 lambda: audit.tag_fragments)
         out = prog(_pad_axis0(ids, bucket),
                    _pad_axis0(frags, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_verify_batch(self, batch):
+    def _op_verify_batch(self, batch, degraded=False):
+        audit = self._audit_backend(degraded)
         aux = batch[0].aux
         ids = _concat_rows([r.arrays["ids"] for r in batch])
         mu = _concat_rows([r.arrays["mu"] for r in batch])
@@ -734,15 +906,15 @@ class SubmissionEngine:
         bucket = bucket_rows(total)
         num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
         prog = self.programs.get(
-            ("verify_batch", batch[0].key, bucket),
-            lambda: (lambda i, u, s: self.audit.verify_batch(
+            self._key(("verify_batch", batch[0].key, bucket), degraded),
+            lambda: (lambda i, u, s: audit.verify_batch(
                 i, num_blocks, idx, nu, u, s)))
         out = prog(_pad_axis0(ids, bucket),
                    _pad_axis0(mu, bucket),
                    _pad_axis0(sigma, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_verify_agg(self, batch):
+    def _op_verify_agg(self, batch, degraded=False):
         from ..ops import podr2
 
         aux = batch[0].aux
@@ -759,7 +931,7 @@ class SubmissionEngine:
             mu[i] = r.arrays["mu"]
             sigma[i] = r.arrays["sigma"]
         num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
-        audit = self.audit
+        audit = self._audit_backend(degraded)
 
         def build():
             fn = jax.vmap(lambda i, rr, u, s: podr2.verify_aggregate(
@@ -770,13 +942,14 @@ class SubmissionEngine:
                     return fn(i, rr, u, s)
             return run
 
-        prog = self.programs.get(("verify_agg", batch[0].key, fb, rb),
-                                 build)
+        prog = self.programs.get(
+            self._key(("verify_agg", batch[0].key, fb, rb), degraded),
+            build)
         out = np.asarray(prog(ids, rs, mu, sigma))
         results = [bool(out[i]) for i in range(len(batch))]
         return results, rb * fb
 
-    def _op_prove(self, batch):
+    def _op_prove(self, batch, degraded=False):
         from ..ops import podr2
 
         aux = batch[0].aux
@@ -792,7 +965,7 @@ class SubmissionEngine:
             tags[i, :r.rows] = r.arrays["tags"]
             rs[i, :r.rows] = r.arrays["r"]
         idx, nu, sectors = aux["idx"], aux["nu"], aux["sectors"]
-        audit = self.audit
+        audit = self._audit_backend(degraded)
 
         def build():
             fn = jax.vmap(lambda f, t, rr: podr2.prove_aggregate(
@@ -803,7 +976,8 @@ class SubmissionEngine:
                     return fn(f, t, rr)
             return run
 
-        prog = self.programs.get(("prove", batch[0].key, fb, rb), build)
+        prog = self.programs.get(
+            self._key(("prove", batch[0].key, fb, rb), degraded), build)
         mu, sigma = prog(frags, tags, rs)
         mu = np.asarray(mu)
         sigma = np.asarray(sigma)
@@ -814,12 +988,16 @@ class SubmissionEngine:
 def make_engine(k: int | None = None, m: int | None = None, *,
                 rs_backend: str = "cpu", strategy: str | None = None,
                 podr2_key=None, audit_backend: str = "cpu",
-                policy: AdmissionPolicy | None = None) -> SubmissionEngine:
+                policy: AdmissionPolicy | None = None,
+                resilience=None) -> SubmissionEngine:
     """Build an engine over the two trait gates.
 
     k/m select the ErasureCodec geometry (None = no codec: the engine
     serves only audit classes); podr2_key enables the audit classes
     (None = no AuditBackend: tag/prove/verify submits raise).
+    resilience: optional cess_tpu.resilience.ResilienceConfig — retry
+    on saturation, batch-failure isolation, and health-gated CPU
+    degradation (see the module doc's Resilience paragraph).
     """
     codec = None
     if k is not None:
@@ -831,4 +1009,4 @@ def make_engine(k: int | None = None, m: int | None = None, *,
         from ..ops import audit_backend as ab
 
         audit = ab.make_audit_backend(podr2_key, audit_backend)
-    return SubmissionEngine(codec, audit, policy)
+    return SubmissionEngine(codec, audit, policy, resilience=resilience)

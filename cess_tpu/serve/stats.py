@@ -119,6 +119,10 @@ class EngineStats:
         self.programs_built = 0     # program-cache misses (compiles)
         self.programs_reused = 0    # program-cache hits
         self.streams: list[StreamStats] = []   # attached stream drivers
+        # ResilienceStats (cess_tpu/resilience/stats.py) when the
+        # engine is resilience-configured — duck-typed (snapshot()/
+        # metrics()) so this module never imports the package
+        self.resilience = None
 
     def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
         """JSON-shaped dump for the RPC debug endpoint."""
@@ -142,6 +146,8 @@ class EngineStats:
             }
         if self.streams:
             out["streams"] = [s.snapshot() for s in self.streams]
+        if self.resilience is not None:
+            out["resilience"] = self.resilience.snapshot()
         return out
 
     def metrics(self, queue_depths: dict[str, int] | None = None
@@ -162,4 +168,8 @@ class EngineStats:
                     totals[k] += v
             for name, val in stream_gauges(totals).items():
                 out[f"cess_engine_stream_{name}"] = float(val)
+        if self.resilience is not None:
+            # cess_resilience_* rides the same exposition (ISSUE 4:
+            # retry/abandon/breaker gauges beside the engine family)
+            out.update(self.resilience.metrics())
         return out

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience import faults
 from .engine import _pad_axis0
 from .stats import StreamStats
 
@@ -211,10 +212,12 @@ class StreamingIngest:
                     ids = _pad_axis0(fragment_ids[seg_off:seg_off + real],
                                      self.batch)
                 t0 = time.perf_counter()
+                faults.inject("stream.h2d")       # chaos seam: staging
                 dev = self._put(chunk)
                 ids_dev = self._put_ids(ids)
                 st.h2d_s += time.perf_counter() - t0
                 t0 = time.perf_counter()
+                faults.inject("stream.dispatch")  # chaos seam: launch
                 out = program(dev, ids_dev)
                 st.dispatch_s += time.perf_counter() - t0
                 st.batches += 1

@@ -20,6 +20,7 @@ EXPECTED = (
     "fragment_repair_warm_p99_ms",
     "podr2_100k_tag_verify_frags_per_s",
     "stream_encode_tag_GiBps",
+    "degraded_encode_GiBps",
     "rs_4p8_encode_GiBps_per_chip",
 )
 
@@ -55,3 +56,6 @@ def test_bench_smoke_every_metric_finite():
     assert stream["padded_segments"] >= 1          # ragged tail hit
     for field in ("h2d_s", "dispatch_s", "stall_s", "stall_frac"):
         assert field in stream, field
+    # degraded mode (breaker forced open) asserted bit-identical to
+    # the device path before the metric is even emitted (ISSUE 4)
+    assert got["degraded_encode_GiBps"]["bit_identical"] is True

@@ -349,6 +349,19 @@ class TestLockDiscipline:
         r = analysis.lint_paths(paths, root=REPO)
         assert [f.format() for f in r.findings] == []
 
+    def test_resilience_layer_is_clean(self):
+        """ISSUE 4 satellite: the resilience package is scanned by the
+        lock-discipline family (HealthMonitor windows and
+        ResilienceStats counters are touched from batcher + submitter
+        threads) and carries zero findings."""
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "resilience")], root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        # the family really applies there (a dirty fixture fires)
+        d = lint(DIRTY_LOCK, "cess_tpu/resilience/fixture.py")
+        assert "lock-unguarded-write" in rules_at(d)
+
 
 # ---------------------------------------------------------------------------
 # consensus determinism (chain/)

@@ -352,10 +352,10 @@ def test_verify_preempts_queued_encode(pkey):
                       policy=AdmissionPolicy(max_delay=0.4))
     order: list[str] = []
     real_encode, real_verify = eng._op_encode, eng._op_verify_batch
-    eng._op_encode = lambda b: (order.append("encode"),
-                                real_encode(b))[1]
-    eng._op_verify_batch = lambda b: (order.append("verify"),
-                                      real_verify(b))[1]
+    eng._op_encode = lambda b, d=False: (order.append("encode"),
+                                         real_encode(b, d))[1]
+    eng._op_verify_batch = lambda b, d=False: (order.append("verify"),
+                                               real_verify(b, d))[1]
     try:
         f_enc = eng.submit_encode(rnd((1, K, 256), 1))
         time.sleep(0.05)          # verify arrives LATER...
@@ -483,7 +483,7 @@ def test_flush_waits_for_quiescence(pkey):
     codec = rs.make_codec(K, M, backend="cpu")
     eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=10.0))
     real = eng._op_encode
-    eng._op_encode = lambda b: (time.sleep(0.3), real(b))[1]
+    eng._op_encode = lambda b, d=False: (time.sleep(0.3), real(b, d))[1]
     try:
         datas = [rnd((1, K, 64), s) for s in (1, 2)]
         futs = [eng.submit_encode(d) for d in datas]
@@ -504,7 +504,7 @@ def test_close_timeout_rejects_still_queued(pkey):
 
     eng = make_engine(K, M, policy=AdmissionPolicy(max_delay=30.0))
     real = eng._op_encode
-    eng._op_encode = lambda b: (time.sleep(1.5), real(b))[1]
+    eng._op_encode = lambda b, d=False: (time.sleep(1.5), real(b, d))[1]
     # different shapes -> two batches: the first goes in flight (and
     # sleeps), the second is still queued when close() gives up
     f1 = eng.submit_encode(rnd((1, K, 64), 1))
