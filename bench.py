@@ -19,6 +19,10 @@ Metrics (targets from BASELINE.md / BASELINE.json):
   (serve/stream.py) — one H2D per batch, staging overlapped with
   compute, ragged tail included (since r06; every other metric is
   device-resident)
+- stream_encode_tag_traced_GiBps      the streamed metric re-run with
+  a request tracer armed (cess_tpu/obs); its ``trace_overhead_frac``
+  field records (off - on)/off so every round pins what tracing costs
+  on the hot path (since r07; asserted finite in --smoke)
 - rs_4p8_encode_GiBps_per_chip        target >= 12 GiB/s  (config 2)
   printed LAST (the headline metric keeps the tail position). NOTE:
   the BENCH_r01/r02 encode numbers were INFLATED: the old bench
@@ -462,12 +466,18 @@ def main() -> None:
                     help="tiny CPU-safe shapes; every metric asserted "
                          "finite (the tier-1 bench gate)")
     ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--trace", action="store_true",
+                    help="arm a request tracer (cess_tpu/obs) around "
+                         "the instrumented metric paths (stream / "
+                         "degraded / traceov) and write each run's "
+                         "Chrome trace-event JSON to "
+                         "TRACE_<metric>.json (Perfetto-loadable)")
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
-                         "stream,degraded,encode")
+                         "stream,degraded,traceov,encode")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "stream",
-             "degraded", "encode"}
+             "degraded", "traceov", "encode"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -550,8 +560,36 @@ def main() -> None:
         emit("podr2_100k_tag_verify_frags_per_s", v, "fragments/s",
              v / (100_000 / CHALLENGE_ROUND_S))
 
+    def trace_artifact(name):
+        """--trace: arm a tracer for one metric run and write its
+        Chrome trace-event JSON artifact on exit (Perfetto-loadable).
+        A no-op nullcontext otherwise — the disabled path must stay
+        the exact code the headline numbers measure."""
+        import contextlib
+
+        if not args.trace:
+            return contextlib.nullcontext()
+        from cess_tpu.obs import trace as obs_trace
+
+        @contextlib.contextmanager
+        def run():
+            tracer = obs_trace.arm(obs_trace.Tracer(capacity=65536))
+            try:
+                yield tracer
+            finally:
+                obs_trace.disarm()
+                path = f"TRACE_{name}.json"
+                with open(path, "w") as f:
+                    json.dump(tracer.export_chrome(), f)
+                print(json.dumps({"trace_artifact": path,
+                                  "spans": len(tracer.finished())}),
+                      flush=True)
+        return run()
+
     if "stream" in which:
-        v, sstats = bench_stream(jnp, jax, stream_batch, stream_n, seg)
+        with trace_artifact("stream"):
+            v, sstats = bench_stream(jnp, jax, stream_batch, stream_n,
+                                     seg)
         # vs_baseline: against the 12 GiB/s device-resident encode
         # target — the streamed number times from HOST bytes and also
         # pays tagging, so the ratio reads as "how much of the
@@ -567,10 +605,44 @@ def main() -> None:
                     "device_put per batch, staging overlapped with "
                     "compute, ragged tail included)")
 
+    if "traceov" in which:
+        # the tracing-cost pin: the SAME streamed from-host-bytes run,
+        # once with every hook on the no-op singleton and once with a
+        # tracer armed; the delta is what request-scoped tracing costs
+        # the hottest instrumented path. Recorded every round so an
+        # accidentally-expensive hook can never hide (--smoke asserts
+        # the fraction finite; the no-op singleton identity itself is
+        # pinned in tests/test_obs.py).
+        from cess_tpu.obs import trace as obs_trace
+
+        v_off, _ = bench_stream(jnp, jax, stream_batch, stream_n, seg)
+        tracer = obs_trace.Tracer(capacity=65536)
+        with obs_trace.armed(tracer):
+            v_on, _ = bench_stream(jnp, jax, stream_batch, stream_n,
+                                   seg)
+        frac = (v_off - v_on) / v_off
+        if _ASSERT_FINITE:
+            assert np.isfinite(frac), \
+                f"trace_overhead_frac produced {frac!r}"
+        if args.trace:
+            with open("TRACE_traceov.json", "w") as f:
+                json.dump(tracer.export_chrome(), f)
+        emit("stream_encode_tag_traced_GiBps", v_on, "GiB/s",
+             v_on / 12.0,
+             untraced_GiBps=round(v_off, 3),
+             trace_overhead_frac=round(frac, 4),
+             spans=len(tracer.finished()),
+             method="streamed from-host-bytes run with a request "
+                    "tracer armed (cess_tpu/obs); trace_overhead_frac "
+                    "= (untraced - traced)/untraced over back-to-back "
+                    "runs — noise-level values (incl. slightly "
+                    "negative) mean the hooks are free")
+
     if "degraded" in which:
         # always the small CPU-safe shape: this measures the breaker-
         # open CPU floor, and asserts degraded == device bit-for-bit
-        v = bench_degraded(jnp, jax, 2, 256 * 2**10)
+        with trace_artifact("degraded"):
+            v = bench_degraded(jnp, jax, 2, 256 * 2**10)
         emit("degraded_encode_GiBps", v, "GiB/s", v / 12.0,
              bit_identical=True,
              method="engine encode with the resilience breaker forced "

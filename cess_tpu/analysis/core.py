@@ -113,7 +113,8 @@ def register(cls: type) -> type:
 
 def all_rules() -> dict[str, Rule]:
     """The registry with every rule family imported."""
-    from . import determinism, lock_discipline, trace_safety  # noqa: F401
+    from . import (determinism, lock_discipline,  # noqa: F401
+                   span_balance, trace_safety)
 
     return dict(_RULES)
 

@@ -278,9 +278,11 @@ class _NodeRule(Rule):
         parts = path_parts(path)
         # resilience/ joined in ISSUE 4: HealthMonitor windows and
         # ResilienceStats counters are touched from batcher AND
-        # submitter threads — exactly this family's territory
+        # submitter threads — exactly this family's territory.
+        # obs/ joined in ISSUE 5: Tracer ring + Span attrs are shared
+        # between submitter, batcher and scrape threads
         return "serve" in parts or "node" in parts \
-            or "resilience" in parts
+            or "resilience" in parts or "obs" in parts
 
 
 @register

@@ -116,7 +116,9 @@ def _names_in(node: ast.AST) -> set[str]:
 class _DeviceRule(Rule):
     def applies(self, path: str) -> bool:
         parts = path_parts(path)
-        return "ops" in parts or "serve" in parts
+        # obs/ joined in ISSUE 5: the tracing hooks sit beside jitted
+        # hot paths, so the same trace-safety discipline applies there
+        return "ops" in parts or "serve" in parts or "obs" in parts
 
 
 @register

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import constants
+from ..obs import trace
 from ..ops import gf, podr2
 from ..ops.rs import default_strategy, _MatrixApply
 
@@ -101,13 +102,14 @@ class StoragePipeline:
         segments = jnp.asarray(segments)
         b = segments.shape[0]
         data = segments.reshape(b, cfg.k, cfg.fragment_size)
-        if self.engine is not None and self.engine.codec is not None:
-            # zero-copy handoff: the engine accepts and returns
-            # jax.Array, so an already-device-resident batch never
-            # round-trips through the host on its way to the codec
-            return jnp.asarray(self.engine.encode(data))
-        parity = self._parity(data)
-        return jnp.concatenate([data, parity], axis=-2)
+        with trace.span("pipeline.encode", sys="pipeline", segments=b):
+            if self.engine is not None and self.engine.codec is not None:
+                # zero-copy handoff: the engine accepts and returns
+                # jax.Array, so an already-device-resident batch never
+                # round-trips through the host on its way to the codec
+                return jnp.asarray(self.engine.encode(data))
+            parity = self._parity(data)
+            return jnp.concatenate([data, parity], axis=-2)
 
     def tag_step(self, fragments: jnp.ndarray,
                  fragment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
@@ -127,15 +129,17 @@ class StoragePipeline:
             fragment_ids = jnp.asarray(fragment_ids)
             fragment_ids = fragment_ids.reshape(
                 (b * rows, 2) if fragment_ids.ndim == 3 else (b * rows,))
-        if self.engine is not None and self.engine.audit is not None \
-                and fragment_ids.ndim == 2:
-            # engine tag class takes (lo, hi) id pairs; the arange
-            # bench default stays on the direct path. Device arrays
-            # hand off zero-copy (engine returns jax.Array back).
-            tags = jnp.asarray(self.engine.tag_fragments(fragment_ids,
-                                                         flat))
-        else:
-            tags = podr2.tag_fragments(self.podr2_key, fragment_ids, flat)
+        with trace.span("pipeline.tag", sys="pipeline", fragments=b * rows):
+            if self.engine is not None and self.engine.audit is not None \
+                    and fragment_ids.ndim == 2:
+                # engine tag class takes (lo, hi) id pairs; the arange
+                # bench default stays on the direct path. Device arrays
+                # hand off zero-copy (engine returns jax.Array back).
+                tags = jnp.asarray(self.engine.tag_fragments(fragment_ids,
+                                                             flat))
+            else:
+                tags = podr2.tag_fragments(self.podr2_key, fragment_ids,
+                                           flat)
         return tags.reshape(b, rows, *tags.shape[1:])
 
     def fused_program(self):
@@ -187,14 +191,16 @@ class StoragePipeline:
         buffer donated. With an engine the two steps submit through its
         queues (still zero-copy for device-resident inputs)."""
         segments = jnp.asarray(segments)
-        if self.engine is not None:
-            shards = self.encode_step(segments)
-            tags = self.tag_step(shards, fragment_ids)
-            return {"fragments": shards, "tags": tags}
-        b = segments.shape[0]
-        if fragment_ids is None:
-            rows = self.config.k + self.config.m
-            fragment_ids = jnp.arange(b * rows, dtype=jnp.int32)
-        else:
-            fragment_ids = jnp.asarray(fragment_ids)
-        return self.fused_program()(segments, fragment_ids)
+        with trace.span("pipeline.forward", sys="pipeline",
+                        segments=int(segments.shape[0])):
+            if self.engine is not None:
+                shards = self.encode_step(segments)
+                tags = self.tag_step(shards, fragment_ids)
+                return {"fragments": shards, "tags": tags}
+            b = segments.shape[0]
+            if fragment_ids is None:
+                rows = self.config.k + self.config.m
+                fragment_ids = jnp.arange(b * rows, dtype=jnp.int32)
+            else:
+                fragment_ids = jnp.asarray(fragment_ids)
+            return self.fused_program()(segments, fragment_ids)

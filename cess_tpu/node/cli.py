@@ -107,6 +107,17 @@ def main(argv=None) -> int:
                          "the cess_engineStats RPC. 'off' (default) "
                          "keeps every caller on the direct synchronous "
                          "path")
+    ap.add_argument("--trace", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="arm the request-scoped tracer (cess_tpu/obs) "
+                         "for this run: spans from the pipeline / "
+                         "engine / stream / resilience / net seams "
+                         "are collected in a bounded ring, served "
+                         "live via the cess_traceDump RPC, and — "
+                         "with --trace=PATH — written on exit as "
+                         "Chrome trace-event JSON (open it in "
+                         "Perfetto or chrome://tracing). Without the "
+                         "flag every trace hook is a no-op")
     ap.add_argument("--resilience", default="off",
                     choices=["off", "on"],
                     help="attach the resilience layer "
@@ -245,6 +256,9 @@ def main(argv=None) -> int:
         from .metrics import TelemetryStream
 
         nodes[0].offchain_agents.append(TelemetryStream(args.telemetry))
+    tracer = _arm_cli_tracer(args)
+    if tracer is not None:
+        nodes[0].tracer = tracer      # cess_traceDump RPC surface
     engine = _make_cli_engine(args, spec)
     if engine is not None:
         nodes[0].engine = engine
@@ -280,7 +294,36 @@ def main(argv=None) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_tracer(args, tracer)
     return 0
+
+
+def _arm_cli_tracer(args):
+    """--trace: arm a process-wide Tracer (cess_tpu/obs) for the run;
+    every instrumented seam (pipeline, engine, stream, resilience,
+    net, offchain agents) then records request-scoped spans. Returns
+    the tracer (also attached as ``node.tracer`` by the callers so
+    cess_traceDump serves it) or None."""
+    if args.trace is None:
+        return None
+    from ..obs import trace as obs_trace
+
+    return obs_trace.arm(obs_trace.Tracer(capacity=65536))
+
+
+def _finish_cli_tracer(args, tracer) -> None:
+    """Disarm and, when --trace carried a PATH, write the Chrome
+    trace-event JSON artifact (open it in Perfetto)."""
+    if tracer is None:
+        return
+    from ..obs import trace as obs_trace
+
+    obs_trace.disarm()
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump(tracer.export_chrome(), f)
+        print(f"trace written to {args.trace} "
+              f"({len(tracer.finished())} spans)", file=sys.stderr)
 
 
 def _make_cli_engine(args, spec):
@@ -400,6 +443,9 @@ def _run_tcp_node(args, spec) -> int:
 
         node.offchain_agents.append(TelemetryStream(args.telemetry))
     peers = [int(p) for p in args.peers.split(",") if p.strip()]
+    tracer = _arm_cli_tracer(args)
+    if tracer is not None:
+        node.tracer = tracer          # cess_traceDump RPC surface
     engine = _make_cli_engine(args, spec)
     if engine is not None:
         node.engine = engine
@@ -434,6 +480,7 @@ def _run_tcp_node(args, spec) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_tracer(args, tracer)
     return 0
 
 

@@ -57,15 +57,36 @@ def collect(node) -> dict[str, float]:
     engine = getattr(node, "engine", None)
     if engine is not None:
         m.update(engine.stats_metrics())
+    # telemetry-stream delivery counters (satellite: drops and sends
+    # were previously silent — a dead collector looked identical to a
+    # healthy one from the node's own metrics)
+    for agent in getattr(node, "offchain_agents", ()):
+        counters = getattr(agent, "telemetry_counters", None)
+        if callable(counters):
+            m.update(counters())
     return m
 
 
 def render_metrics(node) -> str:
-    """Prometheus text exposition format 0.0.4."""
+    """Prometheus text exposition format 0.0.4.
+
+    TYPE lines are per-family and honest: monotonic ``*_total`` series
+    declare ``counter`` (they used to claim ``gauge``, which breaks
+    rate() semantics downstream), latency families from the engine
+    render as real cumulative ``histogram`` buckets
+    (``_bucket{le=...}``/``_sum``/``_count``), everything else stays
+    ``gauge``. tests/test_metrics.py round-trips this output."""
     lines = []
     for name, value in sorted(collect(node).items()):
-        lines.append(f"# TYPE {name} gauge")
+        kind = "counter" if name.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {value}")
+    engine = getattr(node, "engine", None)
+    if engine is not None:
+        from ..obs import prom
+
+        for family, hist in sorted(engine.stats_histograms().items()):
+            lines.extend(prom.render_histogram(family, hist))
     return "\n".join(lines) + "\n"
 
 
@@ -80,7 +101,16 @@ class TelemetryStream:
     into a bounded queue; ALL network IO (blocking connects to
     firewalled hosts included — a 1 s SYN timeout on the import thread
     would eat the slot budget, review-caught) runs on a dedicated
-    sender thread, and a full queue drops the oldest records."""
+    sender thread, and a full queue drops the oldest records.
+
+    Delivery is COUNTED, not silent: every record that reaches the
+    endpoint increments ``sent``, every record lost (queue overflow,
+    endpoint down, broken connection) increments ``dropped``, and both
+    ride the /metrics exposition as ``cess_telemetry_sent_total`` /
+    ``cess_telemetry_dropped_total`` — so a dead collector is visible
+    from the node's own scrape. With a tracer armed
+    (cess_tpu/obs), each record also carries the session trace id, so
+    an external collector's rows can be joined against a trace dump."""
 
     RECONNECT_COOLDOWN = 5.0
     QUEUE_CAP = 256
@@ -92,11 +122,25 @@ class TelemetryStream:
         host, _, port = endpoint.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
+        # delivery counters, single-writer each so no lock is needed:
+        # sent/dropped belong to the sender thread, overflow drops to
+        # the import thread (a shared `+= 1` from both threads is a
+        # read-modify-write race that loses counts under GIL
+        # preemption); scrapes sum them read-only
+        self.sent = 0
+        self.dropped = 0
+        self._overflow_dropped = 0
         self._q: "queue.Queue[dict | None]" = queue.Queue(self.QUEUE_CAP)
         self._sock = None
         self._next_try = 0.0
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
+
+    def telemetry_counters(self) -> dict[str, float]:
+        """Merged into the node /metrics exposition (collect())."""
+        return {"cess_telemetry_sent_total": float(self.sent),
+                "cess_telemetry_dropped_total":
+                    float(self.dropped + self._overflow_dropped)}
 
     def on_block(self, node) -> None:
         head = node.head()
@@ -111,6 +155,7 @@ class TelemetryStream:
             "authorities": len(node.authorities),
             "version": _spec_version(node),
         }
+        _stamp_trace(rec)
         import queue
 
         try:
@@ -118,6 +163,7 @@ class TelemetryStream:
         except queue.Full:
             try:                       # drop the OLDEST, keep current
                 self._q.get_nowait()
+                self._overflow_dropped += 1
                 self._q.put_nowait(rec)
             except queue.Empty:
                 pass
@@ -130,10 +176,13 @@ class TelemetryStream:
                 return
             sock = self._connect()
             if sock is None:
-                continue               # endpoint down: record dropped
+                self.dropped += 1      # endpoint down: record dropped
+                continue
             try:
                 sock.sendall((json.dumps(rec) + "\n").encode())
+                self.sent += 1
             except OSError:
+                self.dropped += 1
                 self._drop_conn()
 
     def _connect(self):
@@ -176,6 +225,17 @@ def _spec_version(node) -> int:
     return migrations.spec_version(node.runtime.state)
 
 
+def _stamp_trace(rec: dict) -> None:
+    """With a tracer armed (cess_tpu/obs), stamp the record with the
+    trace id its head block was imported under, so telemetry rows and
+    block logs join against an exported trace dump. No-op otherwise."""
+    from ..obs import trace
+
+    tracer = trace.armed_tracer()
+    if tracer is not None:
+        rec["trace_id"] = tracer.trace_id
+
+
 class BlockLogger:
     """Offchain-agent-shaped structured logger: one JSON line per
     imported/authored block (height, hash, author, events, pool)."""
@@ -195,4 +255,5 @@ class BlockLogger:
             "events": len(node.runtime.state.events),
             "tx_pool": len(node.tx_pool),
         }
+        _stamp_trace(rec)
         print(json.dumps(rec), file=self.stream, flush=True)

@@ -45,6 +45,15 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
   ("contact", Contact)             DHT bootstrap: advertises this
                                    node's (gossip_port, dht_port) to
                                    seed routing tables
+  ("traced", (trace_id, span_id, inner_frame))
+                                   trace envelope (cess_tpu/obs): only
+                                   emitted while a tracer is armed;
+                                   receivers unwrap and handle the
+                                   inner frame under a net.recv span
+                                   that joins the sender's distributed
+                                   trace (gossip dedup keys on the
+                                   INNER frame, so the span context
+                                   never splits the seen-set)
 
 Authority discovery is STRUCTURED (cess_tpu/node/dht.py): a Kademlia
 DHT on a second OS-assigned port answers single-shot find_node /
@@ -66,6 +75,7 @@ import time
 from .. import codec
 from ..chain.state import DispatchError
 from ..crypto import ed25519
+from ..obs import trace as obs_trace
 from ..resilience import faults
 from . import dht as dht_mod
 
@@ -436,13 +446,26 @@ class NodeService:
             self.conns.remove(conn)
 
     # -- sending ------------------------------------------------------------
+    @staticmethod
+    def _envelope(msg):
+        """Trace envelope (cess_tpu/obs): with a tracer armed, gossip
+        frames travel as ``("traced", (trace_id, span_id, inner))`` so
+        the receiving node's handling span joins the sender's
+        distributed trace — a challenge -> prove -> verify round
+        becomes ONE trace across nodes. With no tracer armed the frame
+        is untouched (wire compatibility + zero cost)."""
+        ctx = obs_trace.context()
+        if ctx is None:
+            return msg
+        return ("traced", (ctx[0], ctx[1], msg))
+
     def _send(self, conn: _Conn, msg) -> None:
         if self.faults is not None and not self.faults.allow():
             return
         if not faults.allow("net.send"):
             return   # seeded chaos drop (cess_tpu/resilience/faults.py)
         self.msgs_sent += 1
-        conn.send(codec.encode(msg))
+        conn.send(codec.encode(self._envelope(msg)))
 
     def _mark_seen(self, digest: bytes) -> None:
         self._seen.add(digest)
@@ -459,6 +482,12 @@ class NodeService:
             import hashlib
 
             self._mark_seen(hashlib.sha256(raw).digest())
+        env = self._envelope(msg)
+        if env is not msg:
+            # dedup identity stays the INNER frame (hash above) so a
+            # message wrapped with different span contexts still
+            # dedups; only the wire bytes carry the envelope
+            raw = codec.encode(env)
         for conn in list(self.conns):
             if conn.alive:
                 if self.faults is not None and not self.faults.allow():
@@ -480,6 +509,20 @@ class NodeService:
         import hashlib
 
         kind, payload = msg
+        if kind == "traced":
+            # trace envelope (see _envelope): unwrap, then handle the
+            # inner frame under a recv span that joins the sender's
+            # trace. A node without an armed tracer just unwraps.
+            remote_tid, remote_sid, inner = payload
+            tracer = obs_trace.armed_tracer()
+            if tracer is None:
+                self._handle(inner, conn)
+                return
+            with tracer.start(f"net.recv:{inner[0]}", sys="net",
+                              remote=(remote_tid, remote_sid),
+                              current=True):
+                self._handle(inner, conn)
+            return
         raw_hash = hashlib.sha256(codec.encode(msg)).digest()
         if kind in ("tx", "block", "vote", "just"):
             if self._was_seen(raw_hash):

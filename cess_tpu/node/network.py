@@ -30,6 +30,7 @@ import hashlib
 from .. import codec
 from ..chain.extrinsic import SignedExtrinsic, sign_extrinsic
 from ..chain.state import DispatchError
+from ..obs import trace
 from .chain_spec import ChainSpec
 from .consensus import Rrsc, SlotClaim
 from .finality import FinalityGadget, Justification
@@ -626,7 +627,13 @@ class Network:
         author_node.commit_proposal()
         for node in self.nodes:
             if node is not author_node:
-                node.import_block(best)
+                # the in-process gossip hop: one delivery span per peer
+                # import (the socket transport's envelope analog, so an
+                # armed tracer sees the same net-hop stage here as the
+                # TCP service's net.send/net.recv spans record)
+                with trace.span("net.deliver", sys="net",
+                                block=best.header.number, to=node.name):
+                    node.import_block(best)
         self.exchange_votes()
         return best
 

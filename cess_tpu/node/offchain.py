@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import codec, constants
+from ..obs import trace
 from ..resilience import faults
 from ..chain.file_bank import UserBrief
 from ..chain.state import DispatchError
@@ -75,32 +76,37 @@ class OssGateway:
         frag_hashes = [
             [fragment_hash(b"pending")] * (cfg.k + cfg.m)
             for _ in range(n_segs)]
-        # hash fragments first (ids feed the tag PRF), then tag on
-        # device. The device-resident fragments feed tag_step DIRECTLY
-        # (zero-copy engine handoff): the hashing fetch is the only
-        # D2H, and the fragment bytes are never re-uploaded for tagging
-        frags_dev = self.pipeline.encode_step(jnp.asarray(segments))
-        out_frags = np.asarray(frags_dev)
-        ids = np.zeros((n_segs, cfg.k + cfg.m, 2), dtype=np.uint32)
-        for i in range(n_segs):
-            for j in range(cfg.k + cfg.m):
-                h = fragment_hash(out_frags[i, j].tobytes())
-                frag_hashes[i][j] = h
-                ids[i, j] = podr2.fragment_id_from_hash(h)
-        tags = np.asarray(self.pipeline.tag_step(frags_dev,
-                                                 jnp.asarray(ids)))
-        for i in range(n_segs):
-            for j in range(cfg.k + cfg.m):
-                h = frag_hashes[i][j]
-                self.fragment_store[h] = out_frags[i, j].tobytes()
-                self.tag_store[h] = tags[i, j]
-        seg_list = [(fragment_hash(segments[i].tobytes()),
-                     tuple(frag_hashes[i])) for i in range(n_segs)]
-        file_hash = fragment_hash(b"".join(h for _, fs in seg_list for h in fs))
-        self.node.submit_extrinsic(
-            self.account, "file_bank.upload_declaration", file_hash,
-            seg_list, UserBrief(owner, file_name, bucket), len(data))
-        return file_hash
+        with trace.span("offchain.upload", sys="offchain",
+                        file=file_name, segments=n_segs,
+                        size=len(data)):
+            # hash fragments first (ids feed the tag PRF), then tag on
+            # device. The device-resident fragments feed tag_step
+            # DIRECTLY (zero-copy engine handoff): the hashing fetch is
+            # the only D2H, and the fragment bytes are never
+            # re-uploaded for tagging
+            frags_dev = self.pipeline.encode_step(jnp.asarray(segments))
+            out_frags = np.asarray(frags_dev)
+            ids = np.zeros((n_segs, cfg.k + cfg.m, 2), dtype=np.uint32)
+            for i in range(n_segs):
+                for j in range(cfg.k + cfg.m):
+                    h = fragment_hash(out_frags[i, j].tobytes())
+                    frag_hashes[i][j] = h
+                    ids[i, j] = podr2.fragment_id_from_hash(h)
+            tags = np.asarray(self.pipeline.tag_step(frags_dev,
+                                                     jnp.asarray(ids)))
+            for i in range(n_segs):
+                for j in range(cfg.k + cfg.m):
+                    h = frag_hashes[i][j]
+                    self.fragment_store[h] = out_frags[i, j].tobytes()
+                    self.tag_store[h] = tags[i, j]
+            seg_list = [(fragment_hash(segments[i].tobytes()),
+                         tuple(frag_hashes[i])) for i in range(n_segs)]
+            file_hash = fragment_hash(b"".join(h for _, fs in seg_list
+                                               for h in fs))
+            self.node.submit_extrinsic(
+                self.account, "file_bank.upload_declaration", file_hash,
+                seg_list, UserBrief(owner, file_name, bucket), len(data))
+            return file_hash
 
 
 def filler_bytes(miner: str, index: int, size: int) -> bytes:
@@ -278,8 +284,11 @@ class MinerAgent:
                     or self.account in deal.complete:
                 continue
             row = deal.assigned.index(self.account)
-            if all(self._fetch(seg.fragment_hashes[row])
-                   for seg in deal.segments):
+            with trace.span("offchain.transfer", sys="offchain",
+                            miner=self.account, file=fh):
+                done = all(self._fetch(seg.fragment_hashes[row])
+                           for seg in deal.segments)
+            if done:
                 node.submit_extrinsic(self.account,
                                       "file_bank.transfer_report", fh)
                 self._reported.add(fh)
@@ -300,13 +309,18 @@ class MinerAgent:
         seed = b"".join(ch.net.randoms)
         snap = next(s for s in ch.miners if s.miner == self.account)
         limbs = self.pipeline.podr2_key.limbs
-        service = build_proof(seed, list(snap.service_frags), self.store,
-                              self.tags, limbs=limbs, engine=self.engine)
-        idle = build_proof(seed, list(snap.fillers), self.filler_store,
-                           self.filler_tags, limbs=limbs,
-                           engine=self.engine)
-        node.submit_extrinsic(self.account, "audit.submit_proof",
-                              idle, service)
+        with trace.span("offchain.prove", sys="offchain",
+                        miner=self.account, round=ch.start,
+                        service=len(snap.service_frags),
+                        idle=len(snap.fillers)):
+            service = build_proof(seed, list(snap.service_frags),
+                                  self.store, self.tags, limbs=limbs,
+                                  engine=self.engine)
+            idle = build_proof(seed, list(snap.fillers),
+                               self.filler_store, self.filler_tags,
+                               limbs=limbs, engine=self.engine)
+            node.submit_extrinsic(self.account, "audit.submit_proof",
+                                  idle, service)
 
     # -- restoral servicing -------------------------------------------------------
     def warm_restoral(self) -> None:
@@ -366,17 +380,20 @@ class MinerAgent:
                 break
         if len(present) < cfg.k:
             return False
-        if self.engine is not None and self.engine.codec is not None:
-            rec = self.engine.reconstruct(np.stack(survivors),
-                                          tuple(present), (row,))
-            blob = np.asarray(rec)[0].tobytes()
-        else:
-            from ..ops.rs import make_codec
+        with trace.span("offchain.repair", sys="offchain",
+                        miner=self.account, row=row,
+                        survivors=len(present)):
+            if self.engine is not None and self.engine.codec is not None:
+                rec = self.engine.reconstruct(np.stack(survivors),
+                                              tuple(present), (row,))
+                blob = np.asarray(rec)[0].tobytes()
+            else:
+                from ..ops.rs import make_codec
 
-            codec = make_codec(cfg.k, cfg.m, backend="auto")
-            rec = codec.reconstruct(np.stack(survivors), tuple(present),
-                                    (row,))
-            blob = np.asarray(rec)[0].tobytes()
+                codec = make_codec(cfg.k, cfg.m, backend="auto")
+                rec = codec.reconstruct(np.stack(survivors),
+                                        tuple(present), (row,))
+                blob = np.asarray(rec)[0].tobytes()
         if fragment_hash(blob) != frag_hash:
             return False
         self.store[frag_hash] = blob
@@ -581,23 +598,28 @@ class TeeAgent:
             if (mission.miner, ch.start) in self._submitted:
                 continue  # result already queued, not yet applied
             snap = mission.snapshot   # owed sets frozen at round start
-            service_ok = self._verify(mission.service_proof,
-                                      list(snap.service_frags), seed,
-                                      idx, nu)
-            idle_ok = self._verify(mission.idle_proof, list(snap.fillers),
-                                   seed, idx, nu)
-            self._submitted.add((mission.miner, ch.start))
-            bls_sig = b""
-            if self.bls_sk is not None:
-                from ..chain import audit as audit_mod
-                bls_sig = bls12381.sign(
-                    self.bls_sk, audit_mod.verdict_message(
-                        self.controller, audit_mod.mission_digest(mission),
-                        idle_ok, service_ok))
-            node.submit_extrinsic(self.controller,
-                                  "audit.submit_verify_result",
-                                  mission.miner, idle_ok, service_ok,
-                                  bls_sig)
+            with trace.span("offchain.verify", sys="offchain",
+                            tee=self.controller, miner=mission.miner,
+                            round=ch.start) as vspan:
+                service_ok = self._verify(mission.service_proof,
+                                          list(snap.service_frags), seed,
+                                          idx, nu)
+                idle_ok = self._verify(mission.idle_proof,
+                                       list(snap.fillers), seed, idx, nu)
+                vspan.set(service_ok=service_ok, idle_ok=idle_ok)
+                self._submitted.add((mission.miner, ch.start))
+                bls_sig = b""
+                if self.bls_sk is not None:
+                    from ..chain import audit as audit_mod
+                    bls_sig = bls12381.sign(
+                        self.bls_sk, audit_mod.verdict_message(
+                            self.controller,
+                            audit_mod.mission_digest(mission),
+                            idle_ok, service_ok))
+                node.submit_extrinsic(self.controller,
+                                      "audit.submit_verify_result",
+                                      mission.miner, idle_ok, service_ok,
+                                      bls_sig)
 
     def _verify(self, blob, owed: list[bytes], seed: bytes,
                 idx, nu) -> bool:

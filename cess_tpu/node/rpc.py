@@ -21,6 +21,8 @@ Methods:
   (header-inclusion proofs; pallet-mmr role)
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
   cess_engineStats   (submission-engine queue/batch/latency counters)
+  cess_traceDump     (Chrome trace-event JSON dump of the armed
+                      request tracer, Perfetto-loadable; cess_tpu/obs)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -294,6 +296,17 @@ class RpcServer:
             # null when the node runs without an engine
             engine = getattr(node, "engine", None)
             return None if engine is None else engine.stats_snapshot()
+        if method == "cess_traceDump":
+            # request-scoped tracing dump (cess_tpu/obs): the node's
+            # pinned tracer (node.cli --trace) or the process-armed
+            # one, exported as Chrome trace-event JSON — save the
+            # result and open it in Perfetto. Null when no tracer.
+            from ..obs import trace as obs_trace
+
+            tracer = getattr(node, "tracer", None)
+            if tracer is None:
+                tracer = obs_trace.armed_tracer()
+            return None if tracer is None else tracer.export_chrome()
         if method == "system_version":
             from ..chain import migrations as _mig
 

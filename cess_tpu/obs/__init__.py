@@ -1,0 +1,41 @@
+"""cess_tpu.obs — request-scoped tracing + histogram observability.
+
+Two modules, one contract (zero-cost when off, deterministic when on):
+
+- trace.py  Tracer/Span core: counter-based span ids, contextvars
+            current-span propagation, a bounded ring of finished
+            spans, Chrome trace-event export (Perfetto-loadable), and
+            the (trace_id, span_id) envelope contract that stitches a
+            challenge -> prove -> verify round into ONE distributed
+            trace across nodes. With no tracer armed every hook
+            returns the NOOP_SPAN singleton (tier-1 pins the
+            identity).
+- prom.py   real Prometheus histograms (cumulative _bucket{le=...} /
+            _sum / _count) for the engine and stream latencies,
+            rendered beside the existing gauges by node/metrics.py.
+
+Wire-up: ``node.cli --trace[=PATH]``, ``serve.make_engine(tracer=...)``,
+``bench.py --trace``, and the ``cess_traceDump`` RPC.
+"""
+from .prom import (LATENCY_BUCKETS_S, Histogram, format_le,
+                   render_histogram)
+from .trace import (NOOP_SPAN, Span, Tracer, arm, armed, armed_tracer,
+                    context, current_span, disarm, event, span)
+
+__all__ = [
+    "Histogram",
+    "LATENCY_BUCKETS_S",
+    "NOOP_SPAN",
+    "Span",
+    "Tracer",
+    "arm",
+    "armed",
+    "armed_tracer",
+    "context",
+    "current_span",
+    "disarm",
+    "event",
+    "format_le",
+    "render_histogram",
+    "span",
+]

@@ -1,0 +1,113 @@
+"""Prometheus histogram primitives for the /metrics exposition.
+
+The engine and stream stats export latency *percentiles* as gauges —
+fine for a glance, wrong for aggregation (you cannot average p99s
+across nodes or scrape intervals). A real Prometheus histogram is the
+mergeable form: fixed bucket bounds, cumulative ``_bucket{le=...}``
+counts, ``_sum`` and ``_count`` — the server derives any quantile over
+any window. This module provides the counter (:class:`Histogram`) and
+the text-exposition renderer (:func:`render_histogram`);
+``node/metrics.py`` emits the families beside the existing gauges with
+correct ``# TYPE ... histogram`` declarations.
+
+Thread note: observations come from the engine batcher and stream
+driver threads while the RPC thread renders — every access goes
+through the histogram's own lock, and rendering works from one
+consistent snapshot so the cumulative-bucket invariant (nondecreasing,
+``+Inf`` == ``_count``) holds in every scrape (tests/test_metrics.py).
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+
+# engine/stream latency bounds (seconds): sub-ms device dispatches up
+# through multi-second degraded/backpressure tails
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    """Fixed-bound histogram: ``observe`` is O(log buckets), snapshots
+    are consistent (taken under the lock), and same-bound histograms
+    merge (the engine sums per-driver stream histograms into one
+    exposition family)."""
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_mu")
+
+    def __init__(self, bounds=LATENCY_BUCKETS_S):
+        bs = tuple(float(b) for b in bounds)
+        if not bs or any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])) \
+                or not all(math.isfinite(b) for b in bs):
+            raise ValueError(f"bucket bounds must be finite and "
+                             f"strictly increasing, got {bounds!r}")
+        self.bounds = bs
+        self._counts = [0] * (len(bs) + 1)   # last = above every bound
+        self._sum = 0.0
+        self._count = 0
+        self._mu = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        # Prometheus le is inclusive: first bound >= value
+        i = bisect.bisect_left(self.bounds, value)
+        with self._mu:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Add ``other``'s observations into this histogram (bounds
+        must match exactly)."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different "
+                             f"bounds: {self.bounds} vs {other.bounds}")
+        with other._mu:
+            counts = list(other._counts)
+            total_sum, total_n = other._sum, other._count
+        with self._mu:
+            for i, n in enumerate(counts):
+                self._counts[i] += n
+            self._sum += total_sum
+            self._count += total_n
+        return self
+
+    @property
+    def count(self) -> int:
+        with self._mu:
+            return self._count
+
+    def snapshot(self) -> dict:
+        """One consistent view: ``buckets`` is the CUMULATIVE
+        ``[(le_bound, count_le)...]`` list ending with ``(inf, count)``
+        — exactly the wire semantics of ``_bucket{le=...}``."""
+        with self._mu:
+            counts = list(self._counts)
+            total_sum, total_n = self._sum, self._count
+        buckets, acc = [], 0
+        for bound, n in zip(self.bounds, counts):
+            acc += n
+            buckets.append((bound, acc))
+        buckets.append((math.inf, acc + counts[-1]))
+        return {"buckets": buckets, "sum": total_sum, "count": total_n}
+
+
+def format_le(bound: float) -> str:
+    """Prometheus ``le`` label value: ``+Inf`` for the overflow
+    bucket, shortest exact decimal otherwise."""
+    if math.isinf(bound):
+        return "+Inf"
+    return f"{bound:g}"
+
+
+def render_histogram(name: str, hist: Histogram) -> list[str]:
+    """Text-exposition lines for one histogram family: the TYPE
+    declaration, cumulative buckets, ``_sum`` and ``_count``."""
+    snap = hist.snapshot()
+    lines = [f"# TYPE {name} histogram"]
+    for bound, n in snap["buckets"]:
+        lines.append(f'{name}_bucket{{le="{format_le(bound)}"}} {n}')
+    lines.append(f"{name}_sum {snap['sum']}")
+    lines.append(f"{name}_count {snap['count']}")
+    return lines

@@ -1,0 +1,382 @@
+"""Request-scoped tracing for the serving data plane.
+
+The framework's four instrumented subsystems (engine, stream driver,
+resilience, node) export flat aggregate gauges — good for "is it
+healthy", useless for "where did THIS upload's 40 ms go". This module
+is the per-request signal: a :class:`Tracer` collects :class:`Span`
+records threaded through every data-plane seam (StoragePipeline
+forward, engine queue-wait -> batch -> device dispatch -> resolve,
+streaming h2d/dispatch/stall, resilience retries and fallbacks,
+offchain audit rounds, net envelope hops), so one trace shows one
+request's whole path — the attribution the RS/PoDR2 tuning loop needs
+(batch-composition effects only become actionable per-request; see
+PAPERS.md, Ragged Paged Attention).
+
+Design contracts, in priority order:
+
+- **Zero-cost when off** (the ``resilience.faults`` contract): with no
+  tracer armed every hook is one module-global load and a ``None``
+  check, and returns the process-wide :data:`NOOP_SPAN` singleton — no
+  span object, no dict, no clock read is allocated on the disabled
+  path. tier-1 pins the singleton identity (tests/test_obs.py) and
+  bench.py records the armed-vs-off overhead on the streamed path
+  (``trace_overhead_frac``).
+- **Deterministic span ids**: ids come from a per-tracer counter, and
+  a trace id is fixed at construction — no wall clock, no randomness
+  in identities — so two replays of the same workload under the same
+  seeded FaultPlan produce correlatable traces (timings differ, the
+  span graph does not).
+- **Context propagation**: the current span lives in a
+  ``contextvars.ContextVar``. ``span(...)`` (the ``with``-style hook)
+  makes its span current for the block; children started inside
+  inherit it as parent. Contexts do NOT cross threads — code that
+  hands work to another thread (the engine batcher) carries the span
+  object explicitly, and code that crosses processes carries
+  ``context()`` = ``(trace_id, span_id)`` in the message envelope
+  (node/net.py wraps gossip frames) and rebuilds with ``remote=``.
+- **Bounded memory**: finished spans land in a thread-safe ring buffer
+  (``capacity`` newest kept); an unfinished span is simply absent from
+  exports, never a leak.
+
+Exports: :meth:`Tracer.export_chrome` emits Chrome trace-event JSON
+(one ``"X"`` complete event per span — load it in Perfetto or
+chrome://tracing), the ``cess_traceDump`` RPC serves the same dump
+from a live node, and ``node.cli --trace[=PATH]`` /
+``bench.py --trace`` arm a tracer for a whole run.
+
+``Tracer(jax_annotations=True)`` additionally wraps device batches in
+``jax.profiler.TraceAnnotation`` / ``StepTraceAnnotation`` scopes so
+an XLA profile captured during the run lines up with framework spans.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import os
+import threading
+import time
+
+MAX_EVENTS = 64           # per-span event cap (bounds a hot loop)
+
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "cess_current_span", default=None)
+
+
+class _NoopSpan:
+    """The process-wide no-op span: every disabled hook returns THIS
+    object (singleton — the zero-allocation disabled-path witness),
+    and every method on it is an attribute-free no-op that returns
+    ``self`` so call chains and ``with`` blocks work unchanged."""
+
+    __slots__ = ()
+    span_id = 0
+    trace_id = 0
+
+    def set(self, **attrs):
+        return self
+
+    def event(self, name, **attrs):
+        return self
+
+    def finish(self, **attrs):
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def __bool__(self):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def _json_safe(value):
+    """Attrs ride into JSON exports: coerce the common non-JSON guests
+    (bytes, numpy scalars) instead of failing the whole dump."""
+    if isinstance(value, (bytes, bytearray)):
+        return value.hex()
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    item = getattr(value, "item", None)     # numpy scalar
+    if callable(item):
+        try:
+            return _json_safe(item())
+        except (TypeError, ValueError):
+            pass
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    return repr(value)
+
+
+class Span:
+    """One timed unit of work. Identity (span_id/trace_id/parent_id)
+    is fixed at start; timing is monotonic-clock; ``attrs`` and
+    ``events`` accumulate under the owning tracer's lock (spans cross
+    threads: the engine submitter starts one, the batcher annotates
+    and finishes it)."""
+
+    __slots__ = ("tracer", "name", "sys", "span_id", "parent_id",
+                 "trace_id", "remote_parent", "t0", "dur_s", "attrs",
+                 "events", "tid", "_token", "_finished")
+
+    def __init__(self, tracer: "Tracer", name: str, sys: str,
+                 span_id: int, parent_id: int, trace_id: int,
+                 remote_parent: bool, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.sys = sys
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.trace_id = trace_id
+        self.remote_parent = remote_parent
+        self.t0 = time.monotonic()
+        self.dur_s = 0.0
+        self.attrs = attrs
+        self.events: list[tuple[float, str, dict]] = []
+        self.tid = threading.get_ident()
+        self._token = None
+        self._finished = False
+
+    def set(self, **attrs) -> "Span":
+        """Merge attributes (last write wins)."""
+        with self.tracer._mu:
+            self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, **attrs) -> "Span":
+        """Append a point-in-time annotation (retry fired, fault
+        injected, batch joined); capped at MAX_EVENTS per span."""
+        t = time.monotonic() - self.t0
+        with self.tracer._mu:
+            if len(self.events) < MAX_EVENTS:
+                self.events.append((t, name, attrs))
+        return self
+
+    def finish(self, **attrs) -> "Span":
+        """Close the span: record duration, push it into the tracer's
+        ring buffer, restore the previous current span (if this one
+        was made current in this context). Idempotent."""
+        dur = time.monotonic() - self.t0
+        token = None
+        with self.tracer._mu:
+            if self._finished:
+                return self
+            self._finished = True
+            self.dur_s = dur
+            if attrs:
+                self.attrs.update(attrs)
+            self.tracer._spans.append(self)
+            token, self._token = self._token, None
+        if token is not None:
+            try:
+                _CURRENT.reset(token)
+            except ValueError:
+                pass   # finished from another thread/context: fine
+        return self
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.set(error=repr(exc))
+        self.finish()
+        return False
+
+
+class Tracer:
+    """One trace session: a deterministic span-id counter, a fixed
+    trace id, and a bounded ring buffer of finished spans.
+
+    capacity:        finished spans kept (oldest evicted).
+    trace_id:        the session identity every root span carries;
+                     spans started from a remote ``context()`` adopt
+                     the sender's instead (distributed traces).
+    jax_annotations: instrumented device dispatch sites additionally
+                     open ``jax.profiler`` annotation scopes so an XLA
+                     profile lines up with framework spans.
+    """
+
+    def __init__(self, capacity: int = 4096, trace_id: int = 1,
+                 jax_annotations: bool = False):
+        if capacity < 1:
+            raise ValueError(f"tracer capacity {capacity} < 1")
+        self._mu = threading.Lock()
+        self._next_id = 1
+        self.trace_id = int(trace_id)
+        self.capacity = capacity
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=capacity)
+        self.jax_annotations = jax_annotations
+        self.origin = time.monotonic()   # ts origin for exports
+        self.pid = os.getpid()
+        self.started = 0                 # spans started (ever)
+
+    # -- span creation -------------------------------------------------------
+    def start(self, name: str, *, sys: str = "", parent=None,
+              remote: tuple | None = None, current: bool = False,
+              **attrs) -> Span:
+        """Start a span. MUST be balanced with ``finish()`` — use it as
+        a context manager or close it in a ``finally`` (cesslint's
+        span-balance rule enforces this); an unclosed span never
+        reaches the ring buffer and orphans its children.
+
+        parent:  explicit parent Span; default inherits the context's
+                 current span; NOOP_SPAN/absent current = root.
+        remote:  ``(trace_id, span_id)`` from a peer's ``context()`` —
+                 joins the sender's distributed trace.
+        current: make this span the context's current span until
+                 finish (same-thread ``with`` usage).
+        """
+        if parent is None and remote is None:
+            parent = _CURRENT.get()
+        remote_parent = False
+        if remote is not None:
+            trace_id, parent_id = int(remote[0]), int(remote[1])
+            remote_parent = parent_id != 0
+        elif isinstance(parent, Span):
+            parent_id, trace_id = parent.span_id, parent.trace_id
+        else:
+            parent_id, trace_id = 0, self.trace_id
+        with self._mu:
+            span_id = self._next_id
+            self._next_id += 1
+            self.started += 1
+        span = Span(self, name, sys, span_id, parent_id, trace_id,
+                    remote_parent, dict(attrs))
+        if current:
+            span._token = _CURRENT.set(span)
+        return span
+
+    # -- export --------------------------------------------------------------
+    def finished(self) -> list[dict]:
+        """Finished spans (newest-capacity window) as plain dicts, in
+        finish order."""
+        with self._mu:
+            spans = list(self._spans)
+        return [self._span_dict(s) for s in spans]
+
+    def _span_dict(self, s: Span) -> dict:
+        return {
+            "name": s.name, "sys": s.sys, "span_id": s.span_id,
+            "parent_id": s.parent_id, "trace_id": s.trace_id,
+            "remote_parent": s.remote_parent, "tid": s.tid,
+            "ts_s": round(s.t0 - self.origin, 6),
+            "dur_s": round(s.dur_s, 6),
+            "attrs": {k: _json_safe(v) for k, v in s.attrs.items()},
+            "events": [{"t_s": round(t, 6), "name": n,
+                        "attrs": {k: _json_safe(v)
+                                  for k, v in a.items()}}
+                       for t, n, a in s.events],
+        }
+
+    def export_chrome(self) -> dict:
+        """Chrome trace-event JSON (the ``{"traceEvents": [...]}``
+        object form): one complete (``"ph": "X"``) event per finished
+        span, microsecond timestamps relative to the tracer's origin.
+        Write it to a file and open in Perfetto (ui.perfetto.dev) or
+        chrome://tracing; span attrs + events ride in ``args``."""
+        events = []
+        for s in self.finished():
+            events.append({
+                "name": s["name"],
+                "cat": s["sys"] or "span",
+                "ph": "X",
+                "ts": round(s["ts_s"] * 1e6, 3),
+                "dur": round(s["dur_s"] * 1e6, 3),
+                "pid": self.pid,
+                "tid": s["tid"],
+                "args": {
+                    "span_id": s["span_id"],
+                    "parent": s["parent_id"],
+                    "trace_id": s["trace_id"],
+                    "remote_parent": s["remote_parent"],
+                    "sys": s["sys"],
+                    "events": s["events"],
+                    **s["attrs"],
+                },
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- arming ------------------------------------------------------------------
+_MU = threading.Lock()
+_TRACER: Tracer | None = None
+
+
+def arm(tracer: Tracer) -> Tracer:
+    """Install ``tracer`` as the process-wide armed tracer."""
+    global _TRACER
+    with _MU:
+        _TRACER = tracer
+    return tracer
+
+
+def disarm() -> None:
+    global _TRACER
+    with _MU:
+        _TRACER = None
+
+
+def armed_tracer() -> Tracer | None:
+    return _TRACER
+
+
+@contextlib.contextmanager
+def armed(tracer: Tracer):
+    """``with trace.armed(t): ...`` — arm for the block, always disarm
+    after (tests must never leak a tracer into their neighbors)."""
+    arm(tracer)
+    try:
+        yield tracer
+    finally:
+        disarm()
+
+
+# -- hooks (the only calls production code makes) ----------------------------
+def span(name: str, *, sys: str = "", **attrs):
+    """The ``with``-style hook: a current-context span on the armed
+    tracer, or :data:`NOOP_SPAN` (the singleton) when none is armed —
+    one global load, one ``None`` check, nothing allocated."""
+    tracer = _TRACER
+    if tracer is None:
+        return NOOP_SPAN
+    return tracer.start(name, sys=sys, current=True, **attrs)
+
+
+def current_span():
+    """The context's active span, or :data:`NOOP_SPAN`."""
+    if _TRACER is None:
+        return NOOP_SPAN
+    return _CURRENT.get() or NOOP_SPAN
+
+
+def event(name: str, **attrs) -> None:
+    """Annotate the active span (no-op without one) — the seam the
+    fault injector and retry policies use."""
+    sp = _CURRENT.get()
+    if sp is not None:
+        sp.event(name, **attrs)
+
+
+def context() -> tuple[int, int] | None:
+    """The ``(trace_id, span_id)`` pair a message envelope carries
+    (span_id 0 = no active span), or None when no tracer is armed —
+    the sender side of the distributed-trace contract; the receiver
+    passes it to ``Tracer.start(remote=...)``. The trace id is the
+    CURRENT SPAN's, not the local tracer's: a node relaying a message
+    it handled under a remote-joined ``net.recv`` span must propagate
+    the ORIGINATOR's trace id, or a multi-hop round would fracture
+    into per-node trace ids with dangling parents."""
+    tracer = _TRACER
+    if tracer is None:
+        return None
+    sp = _CURRENT.get()
+    if isinstance(sp, Span):
+        return (sp.trace_id, sp.span_id)
+    return (tracer.trace_id, 0)

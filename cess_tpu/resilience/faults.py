@@ -43,6 +43,8 @@ import time
 
 import numpy as np
 
+from ..obs import trace as _trace
+
 KINDS = ("raise", "delay", "drop", "corrupt")
 
 
@@ -190,6 +192,10 @@ def _fire(site: str) -> FaultSpec | None:
     n, spec = plan._next(site)
     if spec is None:
         return None
+    # fault firings annotate the active trace span (cess_tpu/obs):
+    # chaos runs under an armed tracer show WHERE each injected fault
+    # landed in the request's path; a no-op without a current span
+    _trace.event("fault", site=site, ordinal=n, kind=spec.kind)
     if spec.delay_s:            # sleep OUTSIDE the plan lock
         time.sleep(spec.delay_s)
     if spec.kind == "raise":

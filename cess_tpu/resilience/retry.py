@@ -25,6 +25,8 @@ import dataclasses
 import hashlib
 import time
 
+from ..obs import trace as _trace
+
 
 class Budget:
     """A request's remaining wall-clock allowance, shared across retry
@@ -104,6 +106,8 @@ class RetryPolicy:
                 if attempt >= self.max_attempts or budget.expired():
                     if stats is not None:
                         stats.note_abandoned(cls)
+                    _trace.event("retry.abandon", cls=cls,
+                                 attempt=attempt)
                     raise
                 delay = self.delay_for(attempt, token)
                 left = budget.remaining()
@@ -112,7 +116,13 @@ class RetryPolicy:
                     # guarantee an EngineTimeout: abandon now instead
                     if stats is not None:
                         stats.note_abandoned(cls)
+                    _trace.event("retry.abandon", cls=cls,
+                                 attempt=attempt, budget=True)
                     raise
                 if stats is not None:
                     stats.note_retry(cls)
+                # retries annotate the active span (cess_tpu/obs), so
+                # a traced request shows every backoff it paid
+                _trace.event("retry", cls=cls, attempt=attempt,
+                             delay_s=round(delay, 6))
                 sleep(delay)

@@ -64,6 +64,7 @@ all of the above deterministically in tier-1.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import hashlib
 import threading
@@ -74,6 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace
 from ..resilience import faults
 from ..resilience.retry import Budget
 from .buckets import ProgramCache, bucket_rows
@@ -128,6 +130,10 @@ class _Request:
     future: EngineFuture
     squeeze: bool = False    # 2-D submit: drop the batch axis on return
     device: bool = False     # jax.Array payload: result stays on device
+    # request-scoped trace span (cess_tpu/obs): covers queue-wait ->
+    # batch membership -> device dispatch -> resolve; the NOOP
+    # singleton when no tracer is armed (every touch is then a no-op)
+    span: Any = trace.NOOP_SPAN
 
 
 def _round_digest(num_blocks: int, idx, nu) -> bytes:
@@ -180,11 +186,16 @@ class SubmissionEngine:
 
     def __init__(self, codec=None, audit=None,
                  policy: AdmissionPolicy | None = None,
-                 resilience=None):
+                 resilience=None, tracer=None):
         if codec is None and audit is None:
             raise ValueError("engine needs a codec and/or audit backend")
         self.codec = codec
         self.audit = audit
+        # request-scoped tracing (cess_tpu/obs): an explicitly passed
+        # Tracer pins this engine to it; otherwise the process-armed
+        # tracer (obs.trace.arm) is consulted per request. None + not
+        # armed = every hook is the no-op singleton.
+        self.tracer = tracer
         self.policy = policy or AdmissionPolicy()
         self.stats = EngineStats()
         self.programs = ProgramCache(self.stats)
@@ -468,6 +479,12 @@ class SubmissionEngine:
             return self.stats.metrics(
                 {c: len(q) for c, q in self._queues.items()})
 
+    def stats_histograms(self) -> dict:
+        """Latency histogram families for the /metrics exposition
+        (name -> obs.prom.Histogram); rendering snapshots each one
+        consistently, so no engine lock is needed here."""
+        return self.stats.histograms()
+
     def flush(self, timeout: float | None = None) -> bool:
         """Force-drain everything queued and wait until it resolves
         (no waiting out the coalescing delay). Returns False if the
@@ -508,6 +525,7 @@ class SubmissionEngine:
                         self.stats.classes[cls].failed += 1
                         r.future._reject(EngineClosed(
                             "engine shut down before this request ran"))
+                        r.span.set(outcome="closed").finish()
 
     # ------------------------------------------------------------------
     # internals
@@ -554,6 +572,13 @@ class SubmissionEngine:
                              f"{arr.shape}")
         return arr, squeeze
 
+    def _tracer_now(self):
+        """The tracer serving this call: the engine's pinned one, else
+        whatever is process-armed (obs.trace) — None when tracing is
+        off, and every span hook then touches the no-op singleton."""
+        return self.tracer if self.tracer is not None \
+            else trace.armed_tracer()
+
     def _submit(self, cls: str, key: tuple, rows: int, arrays: dict,
                 aux: dict, timeout: float | None,
                 squeeze: bool = False) -> EngineFuture:
@@ -568,12 +593,22 @@ class SubmissionEngine:
                        aux=aux, enqueue_t=now,
                        deadline=None if timeout is None else now + timeout,
                        future=fut, squeeze=squeeze, device=device)
+        tracer = self._tracer_now()
+        if tracer is not None:
+            # the request span outlives this frame (the batcher thread
+            # finishes it when the future resolves), so no with-block
+            # can own it — every exit path below closes it explicitly
+            req.span = tracer.start(  # cesslint: disable=span-balance — finished at resolve/reject/expire/close (cross-thread span)
+                f"engine.{cls}", sys="engine", cls=cls, rows=rows,
+                op=key[0])
         with self._cond:
             if self._closed:
+                req.span.set(outcome="closed").finish()
                 raise EngineClosed("engine is shut down")
             st = self.stats.classes[cls]
             if len(self._queues[cls]) >= self.policy.queue_cap:
                 st.saturated += 1
+                req.span.set(outcome="saturated").finish()
                 raise EngineSaturated(
                     f"{cls} queue full ({self.policy.queue_cap})")
             st.submitted += 1
@@ -624,6 +659,7 @@ class SubmissionEngine:
                     r.future._reject(EngineTimeout(
                         f"{cls} request deadline expired before "
                         "batching"))
+                    r.span.set(outcome="timeout").finish()
                 else:
                     keep.append(r)
             q.clear()
@@ -704,6 +740,18 @@ class SubmissionEngine:
         q.extend(rest)
         return batch
 
+    def _device_annotation(self, tracer, op: str):
+        """Optional XLA-profile alignment: with jax_annotations on,
+        each device batch dispatch runs inside a
+        jax.profiler.TraceAnnotation scope named like the framework
+        span, so a captured XLA profile lines up with the trace."""
+        if tracer is None or not tracer.jax_annotations:
+            return contextlib.nullcontext()
+        annotation = getattr(jax.profiler, "TraceAnnotation", None)
+        if annotation is None:
+            return contextlib.nullcontext()
+        return annotation(f"cess:{op}")
+
     def _run_batch(self, batch: list[_Request]) -> None:
         cls = batch[0].cls
         op = batch[0].key[0]
@@ -715,14 +763,36 @@ class SubmissionEngine:
             and mon is not None and not mon.allow()
         if degraded:
             res.stats.note_degraded(cls)
+        tracer = self._tracer_now()
+        bspan = trace.NOOP_SPAN
+        if tracer is not None:
+            # the coalesced-batch span: parented to its first member's
+            # request span (the link that makes occupancy/pad-waste
+            # attributable per request); closed on every path below
+            bspan = tracer.start(  # cesslint: disable=span-balance — finished on both the success and error paths below
+                "engine.batch", sys="engine", parent=batch[0].span,
+                op=op, cls=cls, members=len(batch),
+                rows=sum(r.rows for r in batch), degraded=degraded)
+            for r in batch:
+                r.span.event("batched", batch_span=bspan.span_id,
+                             members=len(batch))
         t0 = time.monotonic()
         try:
-            if not degraded:
-                faults.inject("engine.dispatch")   # chaos seam
-            results, device_rows = runner(batch, degraded)
+            # current=True: the device span is the batcher thread's
+            # active span for the dispatch, so fault-injection firings
+            # (faults.inject below) annotate it via obs.event
+            with self._device_annotation(tracer, op), \
+                    (trace.NOOP_SPAN if tracer is None else tracer.start(
+                        f"device.{op}", sys="device", parent=bspan,
+                        current=True, op=op, degraded=degraded,
+                        backend="cpu-fallback" if degraded else "primary")):
+                if not degraded:
+                    faults.inject("engine.dispatch")   # chaos seam
+                results, device_rows = runner(batch, degraded)
         except Exception as e:        # op failure
             if mon is not None and not degraded:
                 mon.record_error()
+            bspan.set(error=repr(e)).finish()
             if res is not None and self._salvage_batch(runner, batch, e,
                                                        mon, degraded):
                 return
@@ -730,15 +800,19 @@ class SubmissionEngine:
                 self.stats.classes[cls].failed += len(batch)
             for r in batch:
                 r.future._reject(e)
+                r.span.set(outcome="error", error=repr(e)).finish()
             return
         if mon is not None and not degraded:
             mon.record_success(time.monotonic() - t0)
-        self._account_batch(batch, device_rows)
+        self._account_batch(batch, device_rows, bspan)
+        bspan.finish()
         for r, out in zip(batch, results):
             r.future._resolve(out)
+            if r.span is not trace.NOOP_SPAN:
+                r.span.set(outcome="ok").finish()
 
-    def _account_batch(self, batch: list[_Request],
-                       device_rows: int) -> None:
+    def _account_batch(self, batch: list[_Request], device_rows: int,
+                       batch_span=trace.NOOP_SPAN) -> None:
         done = time.monotonic()
         real_rows = sum(r.rows for r in batch)
         cls = batch[0].cls
@@ -750,7 +824,22 @@ class SubmissionEngine:
             st.padded_rows += max(device_rows - real_rows, 0)
             st.completed += len(batch)
             for r in batch:
-                st.latencies.append(done - r.enqueue_t)
+                lat = done - r.enqueue_t
+                st.latencies.append(lat)
+                st.hist.observe(lat)
+        # span attribution only when the spans are real: the disabled
+        # path must not pay the round()s / kwargs dicts per request
+        # (the zero-cost-when-off contract, cess_tpu/obs)
+        if batch_span is not trace.NOOP_SPAN:
+            pad = max(device_rows - real_rows, 0)
+            pad_waste = pad / device_rows if device_rows else 0.0
+            batch_span.set(device_rows=device_rows,
+                           pad_waste=round(pad_waste, 4))
+            for r in batch:
+                r.span.set(occupancy=len(batch),
+                           pad_waste=round(pad_waste, 4),
+                           batch_span=batch_span.span_id,
+                           latency_s=round(done - r.enqueue_t, 6))
 
     def _salvage_batch(self, runner: Callable, batch: list[_Request],
                        primary_exc: BaseException, mon,
@@ -763,6 +852,7 @@ class SubmissionEngine:
         caller is done with the batch)."""
         res = self.resilience
         cls = batch[0].cls
+        tracer = self._tracer_now()
         if len(batch) > 1:
             res.stats.note_batch_requeues(len(batch))
         # solo re-runs use the primary backend only while the breaker
@@ -777,6 +867,7 @@ class SubmissionEngine:
             out = None
             exc = primary_exc
             if solo:
+                r.span.event("salvage.solo")
                 try:
                     if not degraded:
                         faults.inject("engine.dispatch")
@@ -791,7 +882,12 @@ class SubmissionEngine:
             if out is None and not degraded and res.fallback \
                     and mon is not None:
                 try:
-                    out, rows = runner([r], True)
+                    with (trace.NOOP_SPAN if tracer is None
+                          else tracer.start("resilience.fallback",
+                                            sys="resilience",
+                                            parent=r.span,
+                                            current=True, cls=cls)):
+                        out, rows = runner([r], True)
                     res.stats.note_fallback(cls)
                 except Exception as e:  # noqa: BLE001 — fallback is best-effort
                     exc = e
@@ -799,9 +895,11 @@ class SubmissionEngine:
                 with self._lock:
                     self.stats.classes[cls].failed += 1
                 r.future._reject(exc)
+                r.span.set(outcome="error", error=repr(exc)).finish()
             else:
                 self._account_batch([r], rows)
                 r.future._resolve(out[0])
+                r.span.set(outcome="ok").finish()
         return True
 
     # -- op runners (batcher thread only) -------------------------------
@@ -989,7 +1087,7 @@ def make_engine(k: int | None = None, m: int | None = None, *,
                 rs_backend: str = "cpu", strategy: str | None = None,
                 podr2_key=None, audit_backend: str = "cpu",
                 policy: AdmissionPolicy | None = None,
-                resilience=None) -> SubmissionEngine:
+                resilience=None, tracer=None) -> SubmissionEngine:
     """Build an engine over the two trait gates.
 
     k/m select the ErasureCodec geometry (None = no codec: the engine
@@ -998,6 +1096,10 @@ def make_engine(k: int | None = None, m: int | None = None, *,
     resilience: optional cess_tpu.resilience.ResilienceConfig — retry
     on saturation, batch-failure isolation, and health-gated CPU
     degradation (see the module doc's Resilience paragraph).
+    tracer: optional cess_tpu.obs.Tracer — request-scoped spans for
+    every submit (queue-wait -> batch -> device dispatch -> resolve);
+    without one the engine still honors a process-armed tracer
+    (obs.trace.arm), and with neither every hook is a no-op.
     """
     codec = None
     if k is not None:
@@ -1009,4 +1111,5 @@ def make_engine(k: int | None = None, m: int | None = None, *,
         from ..ops import audit_backend as ab
 
         audit = ab.make_audit_backend(podr2_key, audit_backend)
-    return SubmissionEngine(codec, audit, policy, resilience=resilience)
+    return SubmissionEngine(codec, audit, policy, resilience=resilience,
+                            tracer=tracer)

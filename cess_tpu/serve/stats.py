@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import collections
 
+from ..obs import prom
 from . import policy
 
 LATENCY_WINDOW = 512     # per-class sliding window for percentiles
@@ -23,7 +24,7 @@ LATENCY_WINDOW = 512     # per-class sliding window for percentiles
 class ClassStats:
     __slots__ = ("submitted", "completed", "failed", "timeouts",
                  "saturated", "batches", "batched_requests", "rows",
-                 "padded_rows", "latencies")
+                 "padded_rows", "latencies", "hist")
 
     def __init__(self):
         self.submitted = 0          # requests admitted to the queue
@@ -36,6 +37,11 @@ class ClassStats:
         self.rows = 0               # real rows across those batches
         self.padded_rows = 0        # pad rows added to reach buckets
         self.latencies = collections.deque(maxlen=LATENCY_WINDOW)
+        # real Prometheus histogram of the same submit->resolve
+        # latencies: unlike the sliding-window percentiles above this
+        # is mergeable across nodes/scrapes, rendered as cumulative
+        # _bucket{le=...}/_sum/_count lines by node/metrics.py
+        self.hist = prom.Histogram(prom.LATENCY_BUCKETS_S)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -74,8 +80,9 @@ class StreamStats:
     only thing hiding it.
     """
 
-    __slots__ = ("batches", "segments", "padded_segments", "bytes_in",
+    _COUNTERS = ("batches", "segments", "padded_segments", "bytes_in",
                  "h2d_s", "dispatch_s", "stall_s", "wall_s")
+    __slots__ = _COUNTERS + ("hist",)
 
     def __init__(self):
         self.batches = 0           # device batches dispatched
@@ -86,9 +93,12 @@ class StreamStats:
         self.dispatch_s = 0.0      # host time dispatching the program
         self.stall_s = 0.0         # host time blocked on device results
         self.wall_s = 0.0          # wall time of completed run() calls
+        # per-batch host time (staging + dispatch) histogram — the
+        # mergeable form beside the aggregate stage clocks above
+        self.hist = prom.Histogram(prom.LATENCY_BUCKETS_S)
 
     def raw(self) -> dict:
-        return {name: getattr(self, name) for name in self.__slots__}
+        return {name: getattr(self, name) for name in self._COUNTERS}
 
     def snapshot(self) -> dict:
         return stream_gauges(self.raw())
@@ -172,4 +182,20 @@ class EngineStats:
             # cess_resilience_* rides the same exposition (ISSUE 4:
             # retry/abandon/breaker gauges beside the engine family)
             out.update(self.resilience.metrics())
+        return out
+
+    def histograms(self) -> dict[str, prom.Histogram]:
+        """Histogram families for the text exposition: one
+        submit->resolve latency family per op class, plus the summed
+        per-batch stream staging+dispatch family when drivers are
+        attached (per-driver histograms share bounds, so the merge is
+        exact — node/metrics.py renders these with
+        ``# TYPE ... histogram``)."""
+        out = {f"cess_engine_{cls}_latency_seconds": st.hist
+               for cls, st in self.classes.items()}
+        if self.streams:
+            merged = prom.Histogram(prom.LATENCY_BUCKETS_S)
+            for s in self.streams:
+                merged.merge(s.hist)
+            out["cess_engine_stream_batch_seconds"] = merged
         return out

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace
 from ..resilience import faults
 from .engine import _pad_axis0
 from .stats import StreamStats
@@ -172,6 +173,24 @@ class StreamingIngest:
                 raise ValueError("fragment_ids rows != segments rows")
         return self._run(segments, fragment_ids)
 
+    def _tracer_now(self):
+        """Tracer serving this run: the attached engine's pinned one,
+        else the process-armed tracer (obs.trace), else None."""
+        if self._engine is not None and self._engine.tracer is not None:
+            return self._engine.tracer
+        return trace.armed_tracer()
+
+    @staticmethod
+    def _step_annotation(tracer, step: int):
+        """XLA-profile alignment for the streamed path: each batch
+        dispatch runs under a jax.profiler.StepTraceAnnotation, so the
+        profiler's per-step view matches the driver's batch spans."""
+        if tracer is None or not tracer.jax_annotations:
+            return None
+        annotation = getattr(jax.profiler, "StepTraceAnnotation", None)
+        return None if annotation is None \
+            else annotation("cess_stream", step_num=step)
+
     def _run(self, segments, fragment_ids) -> Iterator[dict]:
         cfg = self.pipeline.config
         rows = cfg.k + cfg.m
@@ -179,18 +198,30 @@ class StreamingIngest:
         st = self.stats
         t_run = time.perf_counter()
         inflight: collections.deque = collections.deque()
+        run_span = trace.NOOP_SPAN
+        batches = stalls = 0
 
         def drain_one():
+            nonlocal stalls
             out, real = inflight.popleft()
             t0 = time.perf_counter()
             jax.block_until_ready(out["tags"])
-            st.stall_s += time.perf_counter() - t0
+            stall = time.perf_counter() - t0
+            st.stall_s += stall
+            stalls += 1
+            if run_span is not trace.NOOP_SPAN:
+                run_span.event("stall", s=round(stall, 6))
             if real < self.batch:
                 out = {k: v[:real] for k, v in out.items()}
             out["rows"] = real
             return out
 
         try:
+            tracer = self._tracer_now()
+            if tracer is not None:
+                run_span = tracer.start("stream.run", sys="stream",
+                                        batch=self.batch,
+                                        depth=self.depth)
             seg_off = 0
             for chunk in _rebatch(segments, self.batch):
                 # enforce the in-flight window BEFORE staging the next
@@ -201,9 +232,11 @@ class StreamingIngest:
                     yield drain_one()
                 chunk = np.ascontiguousarray(chunk, dtype=np.uint8)
                 real = chunk.shape[0]
+                pad = 0
                 if real < self.batch:          # ragged tail: pad, reuse
                     chunk = _pad_axis0(chunk, self.batch)
-                    st.padded_segments += self.batch - real
+                    pad = self.batch - real
+                    st.padded_segments += pad
                 if fragment_ids is None:
                     ids = np.arange(seg_off * rows,
                                     (seg_off + self.batch) * rows,
@@ -211,16 +244,41 @@ class StreamingIngest:
                 else:
                     ids = _pad_axis0(fragment_ids[seg_off:seg_off + real],
                                      self.batch)
-                t0 = time.perf_counter()
-                faults.inject("stream.h2d")       # chaos seam: staging
-                dev = self._put(chunk)
-                ids_dev = self._put_ids(ids)
-                st.h2d_s += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                faults.inject("stream.dispatch")  # chaos seam: launch
-                out = program(dev, ids_dev)
-                st.dispatch_s += time.perf_counter() - t0
+                bspan = trace.NOOP_SPAN if tracer is None \
+                    else tracer.start("stream.batch", sys="stream",
+                                      parent=run_span, rows=real,
+                                      pad=pad)
+                try:
+                    t0 = time.perf_counter()
+                    faults.inject("stream.h2d")   # chaos seam: staging
+                    dev = self._put(chunk)
+                    ids_dev = self._put_ids(ids)
+                    h2d = time.perf_counter() - t0
+                    st.h2d_s += h2d
+                    t0 = time.perf_counter()
+                    faults.inject("stream.dispatch")  # chaos: launch
+                    ann = self._step_annotation(tracer, st.batches)
+                    if ann is None:
+                        out = program(dev, ids_dev)
+                    else:
+                        with ann:
+                            out = program(dev, ids_dev)
+                except BaseException as e:
+                    # a staging/dispatch failure (fault injection, OOM)
+                    # must still land the batch span in the ring, error
+                    # attached — a traced chaos run shows WHICH batch
+                    # died, not a silent hole in the export
+                    if bspan is not trace.NOOP_SPAN:
+                        bspan.set(error=repr(e)).finish()
+                    raise
+                dispatch = time.perf_counter() - t0
+                st.dispatch_s += dispatch
+                st.hist.observe(h2d + dispatch)
+                if bspan is not trace.NOOP_SPAN:
+                    bspan.finish(h2d_s=round(h2d, 6),
+                                 dispatch_s=round(dispatch, 6))
                 st.batches += 1
+                batches += 1
                 st.segments += real
                 st.bytes_in += real * cfg.segment_size
                 seg_off += self.batch
@@ -229,6 +287,8 @@ class StreamingIngest:
                 yield drain_one()
         finally:
             st.wall_s += time.perf_counter() - t_run
+            if run_span is not trace.NOOP_SPAN:
+                run_span.finish(batches=batches, stalls=stalls)
 
     def ingest(self, segments, fragment_ids=None) -> dict:
         """Run the whole stream and concatenate the per-batch device

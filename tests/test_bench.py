@@ -20,6 +20,7 @@ EXPECTED = (
     "fragment_repair_warm_p99_ms",
     "podr2_100k_tag_verify_frags_per_s",
     "stream_encode_tag_GiBps",
+    "stream_encode_tag_traced_GiBps",
     "degraded_encode_GiBps",
     "rs_4p8_encode_GiBps_per_chip",
 )
@@ -59,3 +60,10 @@ def test_bench_smoke_every_metric_finite():
     # degraded mode (breaker forced open) asserted bit-identical to
     # the device path before the metric is even emitted (ISSUE 4)
     assert got["degraded_encode_GiBps"]["bit_identical"] is True
+    # the tracing-cost pin (ISSUE 5): armed-vs-off throughput on the
+    # streamed path, with the overhead fraction recorded and finite
+    traced = got["stream_encode_tag_traced_GiBps"]
+    assert math.isfinite(traced["trace_overhead_frac"])
+    assert traced["spans"] >= 1          # the armed run really traced
+    assert math.isfinite(traced["untraced_GiBps"]) \
+        and traced["untraced_GiBps"] > 0

@@ -1,0 +1,312 @@
+"""Exposition-format correctness for the /metrics surface (ISSUE 5).
+
+The exposition used to declare every series ``gauge`` — including
+monotonic ``*_total`` counters — and had no histogram families at all.
+These tests pin the fixed contract:
+
+- per-family TYPE agreement (``*_total`` -> counter, bucket families
+  -> histogram, everything else gauge; exactly one TYPE line per
+  family);
+- histogram wire invariants (cumulative ``le`` buckets nondecreasing,
+  ``+Inf`` bucket == ``_count``, ``_sum`` consistent with
+  observations);
+- a full round-trip parse of ``render_metrics`` output (every
+  non-comment line is ``name[{labels}] value``);
+- telemetry delivery counters (sends AND drops counted, exposed as
+  ``cess_telemetry_*_total``) and the armed-tracer trace id on
+  telemetry/BlockLogger records;
+- Chrome-trace JSON schema checks for the tracer export (every event
+  carries ts/dur/pid/tid, declared parents exist).
+"""
+import io
+import json
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cess_tpu import obs
+from cess_tpu.node.chain_spec import dev_spec
+from cess_tpu.node.metrics import (BlockLogger, TelemetryStream,
+                                   collect, render_metrics)
+from cess_tpu.node.network import Node
+from cess_tpu.serve import AdmissionPolicy, make_engine
+
+K, M = 2, 1
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    obs.disarm()
+
+
+@pytest.fixture()
+def node_with_engine():
+    node = Node(dev_spec(), "metrics-node", {})
+    engine = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.002))
+    node.engine = engine
+    rng = np.random.default_rng(5)
+    engine.encode(rng.integers(0, 256, (2, K, 64), dtype=np.uint8))
+    yield node
+    engine.close()
+
+
+# -- exposition parsing ------------------------------------------------------
+_SAMPLE = re.compile(
+    r'^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$')
+_TYPE = re.compile(r"^# TYPE (?P<name>\S+) (?P<kind>\S+)$")
+
+
+def parse_exposition(text: str):
+    """(types, samples): TYPE declarations by family, and every sample
+    as (name, labels-dict, float). Raises on any malformed line."""
+    types: dict[str, str] = {}
+    samples: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = _TYPE.match(line)
+            assert m, f"malformed comment line: {line!r}"
+            assert m.group("name") not in types, \
+                f"duplicate TYPE for {m.group('name')}"
+            types[m.group("name")] = m.group("kind")
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for part in m.group("labels").split(","):
+                key, _, val = part.partition("=")
+                labels[key] = val.strip('"')
+        samples.append((m.group("name"), labels,
+                        float(m.group("value"))))
+    return types, samples
+
+
+def family_of(sample_name: str, types: dict[str, str]) -> str:
+    """A sample's family: histogram samples append _bucket/_sum/_count
+    to the declared family name."""
+    if sample_name in types:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[:-len(suffix)]
+            if base in types:
+                return base
+    raise AssertionError(f"sample {sample_name} has no TYPE family")
+
+
+class TestExposition:
+    def test_roundtrip_parse(self, node_with_engine):
+        text = render_metrics(node_with_engine)
+        types, samples = parse_exposition(text)
+        assert samples, "empty exposition"
+        # every sample belongs to a declared family, and every
+        # declared family has at least one sample
+        seen = {family_of(name, types) for name, _, _ in samples}
+        assert seen == set(types)
+
+    def test_type_lines_per_family(self, node_with_engine):
+        types, samples = parse_exposition(
+            render_metrics(node_with_engine))
+        for name, kind in types.items():
+            if kind == "histogram":
+                continue
+            expected = "counter" if name.endswith("_total") else "gauge"
+            assert kind == expected, (name, kind)
+        # the seeded satellite case: monotonic event counters are
+        # counters now, not gauges (node/metrics.py:67 regression)
+        assert types["cess_audit_pass_total"] == "counter"
+        assert types["cess_extrinsic_failed_total"] == "counter"
+        assert types["cess_block_height"] == "gauge"
+        # engine latency families render as real histograms
+        assert types["cess_engine_encode_latency_seconds"] == "histogram"
+
+    def test_histogram_bucket_invariants(self, node_with_engine):
+        types, samples = parse_exposition(
+            render_metrics(node_with_engine))
+        hist_families = [n for n, k in types.items() if k == "histogram"]
+        assert hist_families
+        for fam in hist_families:
+            buckets = [(labels["le"], v) for n, labels, v in samples
+                       if n == fam + "_bucket"]
+            count = next(v for n, _, v in samples if n == fam + "_count")
+            total = next(v for n, _, v in samples if n == fam + "_sum")
+            assert buckets[-1][0] == "+Inf"
+            # le bounds strictly increasing, counts cumulative
+            bounds = [float("inf") if le == "+Inf" else float(le)
+                      for le, _ in buckets]
+            assert bounds == sorted(bounds) \
+                and len(set(bounds)) == len(bounds)
+            counts = [v for _, v in buckets]
+            assert counts == sorted(counts), f"{fam} not cumulative"
+            assert counts[-1] == count, f"{fam} +Inf != _count"
+            assert total >= 0
+        # the encode run in the fixture really observed something
+        enc = next(v for n, _, v in samples
+                   if n == "cess_engine_encode_latency_seconds_count")
+        assert enc >= 1
+
+    def test_histogram_observations_consistent(self):
+        h = obs.Histogram((0.01, 0.1, 1.0))
+        for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(5.565)
+        # le is INCLUSIVE: 0.01 lands in the 0.01 bucket
+        assert [n for _, n in snap["buckets"]] == [2, 3, 4, 5]
+        # merge adds exactly
+        h2 = obs.Histogram((0.01, 0.1, 1.0))
+        h2.observe(0.2)
+        h.merge(h2)
+        assert h.snapshot()["count"] == 6
+        with pytest.raises(ValueError):
+            h.merge(obs.Histogram((0.5, 1.0)))
+
+
+# -- telemetry counters + trace ids ------------------------------------------
+class TestTelemetry:
+    def _wait(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_sent_counter_and_trace_id(self):
+        received = []
+
+        def serve(srv):
+            conn, _ = srv.accept()
+            buf = b""
+            conn.settimeout(5.0)
+            try:
+                while b"\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            except OSError:
+                pass
+            received.append(buf)
+            conn.close()
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        t = threading.Thread(target=serve, args=(srv,), daemon=True)
+        t.start()
+        node = Node(dev_spec(), "tele-node", {})
+        stream = TelemetryStream(
+            f"127.0.0.1:{srv.getsockname()[1]}")
+        node.offchain_agents.append(stream)
+        with obs.armed(obs.Tracer(trace_id=9)):
+            stream.on_block(node)
+        try:
+            assert self._wait(lambda: stream.sent >= 1), \
+                "record never delivered"
+            t.join(timeout=5.0)
+            rec = json.loads(received[0].splitlines()[0])
+            assert rec["trace_id"] == 9      # armed-tracer stamp
+            # counters ride the node exposition as counters
+            m = collect(node)
+            assert m["cess_telemetry_sent_total"] >= 1.0
+            assert m["cess_telemetry_dropped_total"] == 0.0
+            types, _ = parse_exposition(render_metrics(node))
+            assert types["cess_telemetry_sent_total"] == "counter"
+        finally:
+            stream.close()
+            srv.close()
+
+    def test_dead_endpoint_counts_drops(self):
+        srv = socket.socket()          # bound but NEVER accepting
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        srv.close()                    # now refused: endpoint down
+        node = Node(dev_spec(), "tele-node2", {})
+        stream = TelemetryStream(f"127.0.0.1:{port}")
+        try:
+            stream.on_block(node)
+            assert self._wait(lambda: stream.dropped >= 1), \
+                "drop on dead endpoint never counted"
+            # no tracer armed: records carry no trace id
+            stream.on_block(node)
+            rec = None
+            deadline = time.monotonic() + 2.0
+            while rec is None and time.monotonic() < deadline:
+                try:
+                    rec = stream._q.queue[0]
+                except IndexError:
+                    stream.on_block(node)
+                    time.sleep(0.01)
+            assert rec is None or "trace_id" not in rec
+        finally:
+            stream.close()
+
+    def test_block_logger_trace_id(self):
+        node = Node(dev_spec(), "log-node", {})
+        out = io.StringIO()
+        logger = BlockLogger(out)
+        with obs.armed(obs.Tracer(trace_id=3)):
+            logger.on_block(node)
+        logger.on_block(node)
+        lines = [json.loads(ln) for ln in
+                 out.getvalue().strip().splitlines()]
+        assert lines[0]["trace_id"] == 3
+        assert "trace_id" not in lines[1]
+
+
+# -- Chrome trace-event schema ----------------------------------------------
+class TestChromeExport:
+    def test_schema_and_parent_links(self):
+        tracer = obs.Tracer(capacity=1024)
+        engine = make_engine(K, M,
+                             policy=AdmissionPolicy(max_delay=0.002),
+                             tracer=tracer)
+        try:
+            rng = np.random.default_rng(6)
+            with obs.armed(tracer):
+                with obs.span("test.root", sys="test"):
+                    engine.encode(rng.integers(0, 256, (2, K, 64),
+                                               dtype=np.uint8))
+        finally:
+            engine.close()
+        dump = tracer.export_chrome()
+        events = dump["traceEvents"]
+        assert events, "no spans exported"
+        ids = set()
+        for ev in events:
+            for field in ("name", "cat", "ph", "ts", "dur", "pid",
+                          "tid", "args"):
+                assert field in ev, (field, ev)
+            assert ev["ph"] == "X"
+            assert isinstance(ev["pid"], int)
+            assert isinstance(ev["tid"], int)
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+            ids.add(ev["args"]["span_id"])
+        assert len(ids) == len(events)       # unique span ids
+        for ev in events:
+            parent = ev["args"]["parent"]
+            if parent and not ev["args"]["remote_parent"]:
+                assert parent in ids, \
+                    f"span {ev['args']['span_id']} orphaned: {parent}"
+        # the whole in-process dump is ONE trace
+        assert {ev["args"]["trace_id"] for ev in events} \
+            == {tracer.trace_id}
+        # JSON-serializable end to end (Perfetto loads a file)
+        json.loads(json.dumps(dump))
+        # engine request spans link their batch span in args
+        req = [ev for ev in events if ev["name"] == "engine.encode"]
+        batch = [ev for ev in events if ev["name"] == "engine.batch"]
+        assert req and batch
+        assert req[0]["args"]["batch_span"] \
+            == batch[0]["args"]["span_id"]
+        assert batch[0]["args"]["parent"] == req[0]["args"]["span_id"]

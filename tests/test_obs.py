@@ -1,0 +1,340 @@
+"""Tracer core + end-to-end acceptance for ISSUE 5 (cess_tpu/obs).
+
+Pins, in order: the zero-cost-when-off contract (every disabled hook
+returns the NOOP_SPAN singleton — no allocation on the hot path),
+deterministic counter-based span ids, context propagation + the
+(trace_id, span_id) envelope, bounded ring-buffer memory, the seam
+instrumentation (engine request spans, stream driver spans,
+fault/retry annotations, the net envelope), CLI/RPC wire-up, and THE
+acceptance scenario: a full offchain audit round (upload -> challenge
+-> prove -> verify) under ``--engine --resilience --trace`` semantics
+producing ONE connected trace that covers six subsystems.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from cess_tpu import obs
+from cess_tpu.models.pipeline import PipelineConfig, StoragePipeline
+from cess_tpu.node.chain_spec import dev_spec
+from cess_tpu.node.network import Node
+from cess_tpu.ops import podr2
+from cess_tpu.resilience import (FaultInjected, FaultPlan, FaultSpec,
+                                 HealthMonitor, ResilienceConfig,
+                                 RetryPolicy, faults)
+from cess_tpu.serve import AdmissionPolicy, StreamingIngest, make_engine
+
+K, M = 2, 1
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    obs.disarm()
+    faults.disarm()
+
+
+def rnd(shape, seed=0, dtype=np.uint8):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, np.iinfo(dtype).max, shape, dtype=dtype)
+
+
+# -- disabled path: the zero-cost contract -----------------------------------
+def test_disabled_hooks_return_the_noop_singleton():
+    """tier-1 pin for the bench satellite: with no tracer armed, every
+    hook hands back the SAME module-global object — nothing is
+    allocated per call on the disabled path."""
+    obs.disarm()
+    assert obs.span("a") is obs.NOOP_SPAN
+    assert obs.span("b", sys="engine", rows=4) is obs.NOOP_SPAN
+    assert obs.current_span() is obs.NOOP_SPAN
+    assert obs.context() is None
+    # the singleton absorbs the full span API and returns itself
+    assert obs.NOOP_SPAN.set(x=1) is obs.NOOP_SPAN
+    assert obs.NOOP_SPAN.event("e", k=2) is obs.NOOP_SPAN
+    assert obs.NOOP_SPAN.finish() is obs.NOOP_SPAN
+    with obs.span("c") as sp:
+        assert sp is obs.NOOP_SPAN
+    obs.event("orphan")      # annotating without a span: silent no-op
+
+
+def test_disabled_engine_and_stream_paths_use_the_singleton():
+    engine = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.002))
+    try:
+        fut = engine.submit_encode(rnd((1, K, 64), 1))
+        fut.result(10)
+    finally:
+        engine.close()
+    # no tracer was armed at any point: nothing recorded anywhere
+    assert obs.armed_tracer() is None
+
+
+# -- core semantics ----------------------------------------------------------
+def test_span_ids_are_counter_based_and_deterministic():
+    def run(tracer):
+        with tracer.start("a", sys="s", current=True):
+            with tracer.start("b", current=True):
+                pass
+        with tracer.start("c", current=True):
+            pass
+        return [(s["name"], s["span_id"], s["parent_id"],
+                 s["trace_id"]) for s in tracer.finished()]
+
+    assert run(obs.Tracer()) == run(obs.Tracer()) == [
+        ("b", 2, 1, 1), ("a", 1, 0, 1), ("c", 3, 0, 1)]
+
+
+def test_context_propagation_and_restoration():
+    tracer = obs.Tracer()
+    with obs.armed(tracer):
+        assert obs.current_span() is obs.NOOP_SPAN
+        with obs.span("outer") as outer:
+            assert obs.current_span() is outer
+            assert obs.context() == (tracer.trace_id, outer.span_id)
+            with obs.span("inner") as inner:
+                assert obs.current_span() is inner
+                assert inner.parent_id == outer.span_id
+            assert obs.current_span() is outer
+        assert obs.current_span() is obs.NOOP_SPAN
+        # explicit parent + non-current spans (the engine shape)
+        sp = tracer.start("detached", parent=outer)
+        assert obs.current_span() is obs.NOOP_SPAN
+        assert sp.parent_id == outer.span_id
+        sp.finish()
+
+
+def test_remote_context_joins_the_senders_trace():
+    tracer = obs.Tracer(trace_id=11)
+    sp = tracer.start("recv", remote=(7, 42))
+    assert (sp.trace_id, sp.parent_id, sp.remote_parent) == (7, 42, True)
+    sp.finish()
+    rec = tracer.finished()[0]
+    assert rec["trace_id"] == 7 and rec["remote_parent"]
+
+
+def test_ring_buffer_is_bounded():
+    tracer = obs.Tracer(capacity=4)
+    for i in range(10):
+        tracer.start(f"s{i}").finish()
+    names = [s["name"] for s in tracer.finished()]
+    assert names == ["s6", "s7", "s8", "s9"]
+    assert tracer.started == 10
+
+
+def test_events_and_error_attrs():
+    tracer = obs.Tracer()
+    with pytest.raises(RuntimeError):
+        with tracer.start("boom", current=True) as sp:
+            sp.event("checkpoint", phase=1)
+            raise RuntimeError("kaput")
+    rec = tracer.finished()[0]
+    assert rec["events"][0]["name"] == "checkpoint"
+    assert "kaput" in rec["attrs"]["error"]
+
+
+# -- seam annotations --------------------------------------------------------
+def test_fault_firings_annotate_the_active_span():
+    plan = FaultPlan({"x.site": {0: FaultSpec("raise")}})
+    tracer = obs.Tracer()
+    with obs.armed(tracer), faults.armed(plan):
+        with pytest.raises(FaultInjected):
+            with obs.span("work"):
+                faults.inject("x.site")
+    rec = tracer.finished()[0]
+    fault_events = [e for e in rec["events"] if e["name"] == "fault"]
+    assert fault_events == [{"t_s": fault_events[0]["t_s"],
+                             "name": "fault",
+                             "attrs": {"site": "x.site", "ordinal": 0,
+                                       "kind": "raise"}}]
+
+
+def test_retries_annotate_the_active_span():
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001)
+    tracer = obs.Tracer()
+    calls = []
+
+    def flaky(budget):
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    with obs.armed(tracer):
+        with obs.span("caller"):
+            assert policy.call(flaky, retry_on=(ValueError,)) == "ok"
+    rec = tracer.finished()[0]
+    retries = [e for e in rec["events"] if e["name"] == "retry"]
+    assert [e["attrs"]["attempt"] for e in retries] == [1, 2]
+
+
+def test_stream_driver_spans():
+    seg = K * 1024                 # 1 KiB fragments -> 2 PoDR2 blocks
+    cfg = PipelineConfig(k=K, m=M, segment_size=seg)
+    pipe = StoragePipeline(cfg)
+    tracer = obs.Tracer()
+    with obs.armed(tracer):
+        for _ in StreamingIngest(pipe, batch=2).run(rnd((5, seg), 3)):
+            pass
+    spans = tracer.finished()
+    runs = [s for s in spans if s["name"] == "stream.run"]
+    batches = [s for s in spans if s["name"] == "stream.batch"]
+    assert len(runs) == 1 and runs[0]["sys"] == "stream"
+    assert len(batches) == 3           # 2 + 2 + ragged 1
+    assert all(b["parent_id"] == runs[0]["span_id"] for b in batches)
+    assert batches[-1]["attrs"]["pad"] == 1
+    assert runs[0]["attrs"]["batches"] == 3
+
+
+def test_engine_request_span_covers_queue_to_resolve():
+    tracer = obs.Tracer()
+    engine = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.002),
+                         tracer=tracer)
+    try:
+        engine.encode(rnd((2, K, 64), 2))
+    finally:
+        engine.close()
+    spans = {s["name"]: s for s in tracer.finished()}
+    req = spans["engine.encode"]
+    batch = spans["engine.batch"]
+    dev = spans["device.encode"]
+    assert req["attrs"]["outcome"] == "ok"
+    assert req["attrs"]["occupancy"] == 1
+    assert [e["name"] for e in req["events"]] == ["batched"]
+    assert batch["parent_id"] == req["span_id"]
+    assert dev["parent_id"] == batch["span_id"]
+    assert dev["attrs"]["backend"] == "primary"
+    assert req["attrs"]["latency_s"] >= 0
+
+
+def test_net_envelope_wraps_and_joins_remote_trace():
+    from cess_tpu.node.net import NodeService
+
+    spec = dev_spec()
+    sender = NodeService(Node(spec, "n0", {}), 39999, [])
+    receiver = NodeService(Node(spec, "n1", {}), 39998, [])
+    msg = ("peers", (1, 2))
+    # disarmed: the wire frame is untouched (compatibility + cost)
+    assert sender._envelope(msg) is msg
+    tracer = obs.Tracer(trace_id=5)
+    with obs.armed(tracer):
+        with obs.span("send-side") as sp:
+            env = sender._envelope(msg)
+        assert env == ("traced", (5, sp.span_id, msg))
+
+        class FakeConn:
+            alive = True
+
+            def send(self, raw):
+                pass
+
+        status = ("status", (0, receiver.node.head().hash(), 0))
+        receiver._handle(("traced", (5, sp.span_id, status)),
+                         FakeConn())
+    recv = [s for s in tracer.finished()
+            if s["name"] == "net.recv:status"]
+    assert len(recv) == 1
+    assert recv[0]["sys"] == "net"
+    assert recv[0]["trace_id"] == 5
+    assert recv[0]["parent_id"] == sp.span_id
+    assert recv[0]["remote_parent"]
+
+
+# -- wire-up: CLI flag + RPC dump --------------------------------------------
+def test_cli_trace_flag_writes_chrome_artifact(tmp_path):
+    from cess_tpu.node.cli import main
+
+    path = tmp_path / "trace.json"
+    assert main(["--dev", "--blocks", "2", f"--trace={path}"]) == 0
+    dump = json.loads(path.read_text())
+    assert "traceEvents" in dump
+    assert obs.armed_tracer() is None    # disarmed on exit
+
+
+def test_rpc_trace_dump_serves_the_node_tracer():
+    from cess_tpu.node.rpc import RpcServer
+
+    node = Node(dev_spec(), "rpc-node", {})
+    rpc = RpcServer(node, port=0).start()
+    try:
+        assert rpc.handle("cess_traceDump", []) is None
+        tracer = obs.Tracer()
+        tracer.start("x", sys="test").finish()
+        node.tracer = tracer
+        dump = rpc.handle("cess_traceDump", [])
+        assert [e["name"] for e in dump["traceEvents"]] == ["x"]
+    finally:
+        rpc.stop()
+
+
+# -- THE acceptance: one connected trace across the audit round --------------
+def test_e2e_audit_round_is_one_connected_six_subsystem_trace():
+    """Upload -> challenge -> prove -> verify with engine + resilience
+    + tracer armed, under a rate-1.0 device-failure plan (the ISSUE 4
+    chaos world): the finished spans form ONE trace (single trace id,
+    every non-remote parent present) covering >= 6 subsystems —
+    pipeline, engine, device program, resilience fallback, net hop,
+    offchain agents — and the Chrome export validates."""
+    from test_resilience import _storage_world
+
+    pkey = podr2.Podr2Key.generate(44)
+    res = ResilienceConfig(monitor=lambda: HealthMonitor(
+        min_samples=2, probe_every=4))
+    tracer = obs.Tracer(capacity=65536)
+    eng = make_engine(K, M, rs_backend="jax", podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.002),
+                      resilience=res, tracer=tracer)
+    plan = FaultPlan.seeded(b"obs-e2e", {
+        "engine.dispatch": (1.0, "raise"),
+        "rs.encode": (1.0, "raise"),
+    }, horizon=65536)
+    try:
+        with obs.armed(tracer), faults.armed(plan):
+            net, node, gw, miners = _storage_world(pkey, eng)
+            data = rnd((40_000,), 12).tobytes()
+            fh = gw.upload("alice", "photos", "cat.jpg", data)
+            net.run_slots(1)
+            assert node.runtime.file_bank.deal(fh) is not None
+            net.run_slots(2)                  # miners fetch + report
+            node.submit_extrinsic("root", "file_bank.calculate_end", fh)
+            net.run_slots(1)
+            rt = node.runtime
+            for _ in range(60):
+                net.run_slots(1)
+                if rt.state.events_of("audit", "VerifyResult"):
+                    break
+            results = rt.state.events_of("audit", "VerifyResult")
+            assert results, "audit round never produced verify results"
+            assert all(dict(e.data)["idle"] and dict(e.data)["service"]
+                       for e in results)
+    finally:
+        eng.close()
+
+    spans = tracer.finished()
+    # ONE trace: every span carries the session trace id, and every
+    # locally-parented span's parent is present in the dump
+    assert {s["trace_id"] for s in spans} == {tracer.trace_id}
+    ids = {s["span_id"] for s in spans}
+    assert len(ids) == len(spans)
+    orphans = [s for s in spans
+               if s["parent_id"] and not s["remote_parent"]
+               and s["parent_id"] not in ids]
+    assert orphans == []
+    # >= 6 subsystems covered by the one round
+    systems = {s["sys"] for s in spans}
+    assert {"pipeline", "engine", "device", "resilience", "net",
+            "offchain"} <= systems, systems
+    names = {s["name"] for s in spans}
+    assert {"offchain.upload", "offchain.prove", "offchain.verify",
+            "engine.batch", "net.deliver",
+            "resilience.fallback"} <= names, names
+    # the injected device failures are annotated where they landed
+    fault_events = [e for s in spans for e in s["events"]
+                    if e["name"] == "fault"]
+    assert any(e["attrs"]["site"] == "engine.dispatch"
+               for e in fault_events)
+    # and the export is well-formed Chrome trace JSON end to end
+    dump = tracer.export_chrome()
+    json.loads(json.dumps(dump))
+    assert all({"name", "ph", "ts", "dur", "pid", "tid",
+                "args"} <= set(ev) for ev in dump["traceEvents"])
