@@ -56,9 +56,14 @@ class SpanBalance(Rule):
             "(# cesslint: disable=span-balance)")
 
     def applies(self, path: str) -> bool:
-        # everywhere tracing is threaded — but not the obs package
-        # itself, whose whole job is constructing and managing spans
-        return "obs" not in path_parts(path)
+        # everywhere tracing is threaded — except trace.py itself,
+        # whose whole job is constructing and managing spans. The
+        # exemption used to cover the whole obs package; ISSUE 6 adds
+        # obs/slo.py (a CONSUMER of spans, not the implementation), so
+        # the carve-out is now exactly the implementation module.
+        parts = path_parts(path)
+        return not ("obs" in parts and parts
+                    and parts[-1] == "trace.py")
 
     def check(self, mod: ParsedModule) -> list[Finding]:
         managed: set[int] = set()

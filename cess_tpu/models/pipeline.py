@@ -91,12 +91,15 @@ class StoragePipeline:
             raise ValueError("engine AuditBackend key differs from "
                              "the pipeline's PoDR2 key")
 
-    def encode_step(self, segments: jnp.ndarray) -> jnp.ndarray:
+    def encode_step(self, segments: jnp.ndarray,
+                    tenant: str | None = None) -> jnp.ndarray:
         """[B, segment_size] uint8 -> [B, k+m, fragment_size] uint8.
 
         Data fragments are the k row-slices of the segment (systematic
         code: fragment bytes == segment bytes, hash-stable), parity
-        fragments follow.
+        fragments follow. ``tenant`` rides into the engine submit for
+        per-tenant accounting (obs/slo.py) — ignored on the direct
+        path and free when the engine has no SLO board.
         """
         cfg = self.config
         segments = jnp.asarray(segments)
@@ -107,12 +110,14 @@ class StoragePipeline:
                 # zero-copy handoff: the engine accepts and returns
                 # jax.Array, so an already-device-resident batch never
                 # round-trips through the host on its way to the codec
-                return jnp.asarray(self.engine.encode(data))
+                return jnp.asarray(self.engine.encode(data,
+                                                      tenant=tenant))
             parity = self._parity(data)
             return jnp.concatenate([data, parity], axis=-2)
 
     def tag_step(self, fragments: jnp.ndarray,
-                 fragment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
+                 fragment_ids: jnp.ndarray | None = None,
+                 tenant: str | None = None) -> jnp.ndarray:
         """[B, k+m, fragment_size] -> PoDR2 tags [B, k+m, blocks, limbs].
 
         fragment_ids: unique-per-key ids ([B, k+m] or [B, k+m, 2] hash
@@ -135,8 +140,8 @@ class StoragePipeline:
                 # engine tag class takes (lo, hi) id pairs; the arange
                 # bench default stays on the direct path. Device arrays
                 # hand off zero-copy (engine returns jax.Array back).
-                tags = jnp.asarray(self.engine.tag_fragments(fragment_ids,
-                                                             flat))
+                tags = jnp.asarray(self.engine.tag_fragments(
+                    fragment_ids, flat, tenant=tenant))
             else:
                 tags = podr2.tag_fragments(self.podr2_key, fragment_ids,
                                            flat)
@@ -182,20 +187,23 @@ class StoragePipeline:
         return self._fused
 
     def forward(self, segments: jnp.ndarray,
-                fragment_ids: jnp.ndarray | None = None) -> dict[str, jnp.ndarray]:
+                fragment_ids: jnp.ndarray | None = None,
+                tenant: str | None = None) -> dict[str, jnp.ndarray]:
         """The full pipeline step: encode + tag (the reference's
         OSS-encode + TEE-tag off-chain compute as one device program).
 
         Without an engine this is the FUSED path: one jitted call, no
         intermediate materialization between encode and tag, segment
         buffer donated. With an engine the two steps submit through its
-        queues (still zero-copy for device-resident inputs)."""
+        queues (still zero-copy for device-resident inputs), carrying
+        the optional per-tenant accounting tag."""
         segments = jnp.asarray(segments)
         with trace.span("pipeline.forward", sys="pipeline",
                         segments=int(segments.shape[0])):
             if self.engine is not None:
-                shards = self.encode_step(segments)
-                tags = self.tag_step(shards, fragment_ids)
+                shards = self.encode_step(segments, tenant=tenant)
+                tags = self.tag_step(shards, fragment_ids,
+                                     tenant=tenant)
                 return {"fragments": shards, "tags": tags}
             b = segments.shape[0]
             if fragment_ids is None:

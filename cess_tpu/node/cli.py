@@ -118,6 +118,34 @@ def main(argv=None) -> int:
                          "Chrome trace-event JSON (open it in "
                          "Perfetto or chrome://tracing). Without the "
                          "flag every trace hook is a no-op")
+    ap.add_argument("--slo", nargs="?", const="", default=None,
+                    metavar="TARGETS",
+                    help="attach an SLO board (cess_tpu/obs/slo.py) to "
+                         "the --engine: burn-rate monitors over the "
+                         "live per-class latency/error signal, "
+                         "per-tenant accounting, and weighted-fair "
+                         "dequeue. TARGETS is ';'-separated "
+                         "<class>:p99=<dur>[,err=<rate>] (e.g. "
+                         "'verify:p99=50ms,err=1%;encode:p99=2s'); "
+                         "omitted = the default targets. Gauges "
+                         "appear as cess_slo_*/cess_tenant_* on GET "
+                         "/metrics and via the cess_sloStatus RPC. "
+                         "Requires --engine; absent = zero-cost off "
+                         "(the --trace contract)")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="trace-driven adaptive control "
+                         "(cess_tpu/serve/adaptive.py) over the "
+                         "--engine: per-class batching knobs tuned "
+                         "from the live latency histograms "
+                         "(occupancy-targeting replaces the static "
+                         "BatchPolicy constants), and — with --slo — "
+                         "deadline-aware admission that sheds or "
+                         "CPU-degrades encode-class load while a "
+                         "verify-class SLO is burning (extends the "
+                         "--resilience breaker from 'device broken' "
+                         "to 'SLO at risk'). Requires --engine and "
+                         "--slo (the board's targets steer the "
+                         "tuner)")
     ap.add_argument("--resilience", default="off",
                     choices=["off", "on"],
                     help="attach the resilience layer "
@@ -340,12 +368,30 @@ def _make_cli_engine(args, spec):
 
     --resilience mirrors the shape: opt-in, wraps THIS engine with
     the retry/isolation/degradation layer (cess_tpu/resilience) and
-    adds the cess_resilience_* counters to the same surfaces."""
+    adds the cess_resilience_* counters to the same surfaces.
+    --slo / --adaptive mirror it again (ISSUE 6): an SLO board with
+    burn-rate monitors + per-tenant accounting, and the adaptive
+    batching/admission layer consuming it — cess_slo_*/cess_tenant_*/
+    cess_adaptive_* counters on the same surfaces plus the
+    cess_sloStatus RPC."""
+    # getattr defaults: embedders hand-build minimal Namespaces
+    slo_spec = getattr(args, "slo", None)
+    adaptive = getattr(args, "adaptive", False)
     if args.engine == "off":
         if args.resilience != "off":
             raise SystemExit("--resilience requires --engine "
                              "(it wraps the submission engine)")
+        if slo_spec is not None:
+            raise SystemExit("--slo requires --engine (it watches the "
+                             "submission engine's latency signal)")
+        if adaptive:
+            raise SystemExit("--adaptive requires --engine (it tunes "
+                             "the submission engine's batching)")
         return None
+    if adaptive and slo_spec is None:
+        raise SystemExit("--adaptive requires --slo (without a board's "
+                         "targets the knob tuner has nothing to steer "
+                         "toward and would silently never adjust)")
     from ..serve import make_engine
 
     resilience = None
@@ -353,9 +399,15 @@ def _make_cli_engine(args, spec):
         from ..resilience import ResilienceConfig
 
         resilience = ResilienceConfig()
+    slo = None
+    if slo_spec is not None:
+        from ..obs.slo import SloBoard, parse_targets
+
+        slo = SloBoard(parse_targets(slo_spec))
     k = max(spec.fragment_count - 1, 1)      # reference RS(k, 1) shape
     return make_engine(k, spec.fragment_count - k,
-                       rs_backend=args.engine, resilience=resilience)
+                       rs_backend=args.engine, resilience=resilience,
+                       slo=slo, adaptive=True if adaptive else None)
 
 
 def _data_dir(args, spec) -> "str | None":

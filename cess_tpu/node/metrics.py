@@ -64,7 +64,23 @@ def collect(node) -> dict[str, float]:
         counters = getattr(agent, "telemetry_counters", None)
         if callable(counters):
             m.update(counters())
+    # tracer ring-buffer evictions (ISSUE 6 satellite): a wrapped span
+    # ring silently turned exports into a window — now the drop count
+    # rides the scrape beside everything else
+    tracer = _node_tracer(node)
+    if tracer is not None:
+        m["cess_trace_spans_dropped_total"] = float(tracer.dropped)
     return m
+
+
+def _node_tracer(node):
+    """The tracer whose counters this node's scrape reports: the
+    node-pinned one (node.cli --trace), else the process-armed tracer,
+    else None (same resolution order as the cess_traceDump RPC)."""
+    from ..obs import trace
+
+    tracer = getattr(node, "tracer", None)
+    return tracer if tracer is not None else trace.armed_tracer()
 
 
 def render_metrics(node) -> str:
@@ -75,7 +91,11 @@ def render_metrics(node) -> str:
     rate() semantics downstream), latency families from the engine
     render as real cumulative ``histogram`` buckets
     (``_bucket{le=...}``/``_sum``/``_count``), everything else stays
-    ``gauge``. tests/test_metrics.py round-trips this output."""
+    ``gauge``. Labeled families (the ``cess_slo_*`` per-class gauges
+    and ``cess_tenant_*`` series from an SLO board) render with
+    escaped label values and exactly ONE TYPE line per family, however
+    many label sets it carries. tests/test_metrics.py round-trips this
+    output."""
     lines = []
     for name, value in sorted(collect(node).items()):
         kind = "counter" if name.endswith("_total") else "gauge"
@@ -87,6 +107,25 @@ def render_metrics(node) -> str:
 
         for family, hist in sorted(engine.stats_histograms().items()):
             lines.extend(prom.render_histogram(family, hist))
+        # labeled gauge/counter families (SLO board): group by family
+        # so the TYPE line appears once, then every label set
+        declared = set()
+        # stable-sorted by family: the exposition format wants every
+        # line of a family in one contiguous group
+        for family, kind, labels, value in sorted(
+                engine.labeled_series(), key=lambda s: s[0]):
+            if family not in declared:
+                declared.add(family)
+                lines.append(f"# TYPE {family} {kind}")
+            lines.append(f"{family}{prom.format_labels(labels)} {value}")
+        # labeled histogram families (per-tenant latency): same
+        # one-TYPE-line discipline across label sets
+        hist_declared = set()
+        for family, labels, hist in engine.labeled_histograms():
+            lines.extend(prom.render_histogram(
+                family, hist, labels=labels,
+                type_line=family not in hist_declared))
+            hist_declared.add(family)
     return "\n".join(lines) + "\n"
 
 

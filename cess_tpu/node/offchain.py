@@ -54,7 +54,14 @@ from .network import Node
 
 
 class OssGateway:
-    """The user-facing gateway: chunk -> encode -> tag -> declare."""
+    """The user-facing gateway: chunk -> encode -> tag -> declare.
+
+    The gateway is where the per-tenant accounting contract
+    (obs/slo.py) STARTS: every engine submit an upload generates is
+    tagged with the uploading OWNER's account, so the exposition's
+    ``cess_tenant_*`` series and the batcher's weighted-fair dequeue
+    see the user behind the bytes — not just the one shared gateway
+    account. Free when the engine has no SLO board."""
 
     def __init__(self, node: Node, account: str,
                  pipeline: StoragePipeline):
@@ -84,7 +91,8 @@ class OssGateway:
             # DIRECTLY (zero-copy engine handoff): the hashing fetch is
             # the only D2H, and the fragment bytes are never
             # re-uploaded for tagging
-            frags_dev = self.pipeline.encode_step(jnp.asarray(segments))
+            frags_dev = self.pipeline.encode_step(jnp.asarray(segments),
+                                                  tenant=owner)
             out_frags = np.asarray(frags_dev)
             ids = np.zeros((n_segs, cfg.k + cfg.m, 2), dtype=np.uint32)
             for i in range(n_segs):
@@ -93,7 +101,8 @@ class OssGateway:
                     frag_hashes[i][j] = h
                     ids[i, j] = podr2.fragment_id_from_hash(h)
             tags = np.asarray(self.pipeline.tag_step(frags_dev,
-                                                     jnp.asarray(ids)))
+                                                     jnp.asarray(ids),
+                                                     tenant=owner))
             for i in range(n_segs):
                 for j in range(cfg.k + cfg.m):
                     h = frag_hashes[i][j]
@@ -315,10 +324,12 @@ class MinerAgent:
                         idle=len(snap.fillers)):
             service = build_proof(seed, list(snap.service_frags),
                                   self.store, self.tags, limbs=limbs,
-                                  engine=self.engine)
+                                  engine=self.engine,
+                                  tenant=self.account)
             idle = build_proof(seed, list(snap.fillers),
                                self.filler_store, self.filler_tags,
-                               limbs=limbs, engine=self.engine)
+                               limbs=limbs, engine=self.engine,
+                               tenant=self.account)
             node.submit_extrinsic(self.account, "audit.submit_proof",
                                   idle, service)
 
@@ -385,7 +396,8 @@ class MinerAgent:
                         survivors=len(present)):
             if self.engine is not None and self.engine.codec is not None:
                 rec = self.engine.reconstruct(np.stack(survivors),
-                                              tuple(present), (row,))
+                                              tuple(present), (row,),
+                                              tenant=self.account)
                 blob = np.asarray(rec)[0].tobytes()
             else:
                 from ..ops.rs import make_codec
@@ -453,10 +465,13 @@ def proof_wire_bytes(limbs: int | None = None,
 def build_proof(seed: bytes, owed: list[bytes],
                 store: dict[bytes, bytes],
                 tags: dict[bytes, np.ndarray],
-                limbs: int | None = None, engine=None) -> bytes:
+                limbs: int | None = None, engine=None,
+                tenant: str | None = None) -> bytes:
     """Miner-side: aggregated proof over the owed set, as wire bytes.
     Fragments the miner no longer holds simply can't contribute — the
-    fold then fails TEE verification (that's the audit)."""
+    fold then fails TEE verification (that's the audit). ``tenant``
+    tags the engine submit (the proving miner's account) for
+    per-tenant accounting."""
     held = [h for h in owed if h in store]
     # the limb WIDTH is a deployment parameter: callers pass it from
     # their PoDR2 key (hardwiring 2 broke limbs=3 deployments; and an
@@ -483,7 +498,8 @@ def build_proof(seed: bytes, owed: list[bytes],
         # coalesce in the engine's prove queue (bit-identical fold)
         mu, sigma = engine.prove_aggregate(frags, tag_arr,
                                            np.asarray(idx),
-                                           np.asarray(nu), np.asarray(r))
+                                           np.asarray(nu), np.asarray(r),
+                                           tenant=tenant)
     else:
         mu, sigma = podr2.prove_aggregate(jnp.asarray(frags),
                                           jnp.asarray(tag_arr), idx, nu,
@@ -649,7 +665,8 @@ class TeeAgent:
             return engine.verify_aggregate(
                 ids, self.blocks, np.asarray(idx), np.asarray(nu),
                 np.asarray(r), np.asarray(proof.mu),
-                np.asarray(proof.sigma, dtype=np.uint32))
+                np.asarray(proof.sigma, dtype=np.uint32),
+                tenant=self.controller)
         ok = podr2.verify_aggregate(self.key, jnp.asarray(ids), self.blocks,
                                     idx, nu, r,
                                     jnp.asarray(proof.mu),

@@ -23,6 +23,9 @@ Methods:
   cess_engineStats   (submission-engine queue/batch/latency counters)
   cess_traceDump     (Chrome trace-event JSON dump of the armed
                       request tracer, Perfetto-loadable; cess_tpu/obs)
+  cess_sloStatus     (SLO board snapshot: per-class burn rates/states/
+                      transitions, per-tenant accounting, adaptive
+                      knobs + admission state; obs/slo.py)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -307,6 +310,21 @@ class RpcServer:
             if tracer is None:
                 tracer = obs_trace.armed_tracer()
             return None if tracer is None else tracer.export_chrome()
+        if method == "cess_sloStatus":
+            # SLO observability debug surface (obs/slo.py): per-class
+            # burn rates / states / transition log + per-tenant
+            # accounting, plus the adaptive knobs and admission state
+            # when configured. Null when the engine has no board.
+            engine = getattr(node, "engine", None)
+            board = None if engine is None else engine.slo
+            if board is None:
+                return None
+            out = board.snapshot()
+            if engine.adaptive is not None:
+                out["adaptive"] = engine.adaptive.snapshot()
+            if engine.admission is not None:
+                out["admission"] = engine.admission.snapshot()
+            return out
         if method == "system_version":
             from ..chain import migrations as _mig
 

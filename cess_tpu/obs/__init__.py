@@ -1,6 +1,7 @@
-"""cess_tpu.obs — request-scoped tracing + histogram observability.
+"""cess_tpu.obs — request-scoped tracing + histogram observability +
+SLO monitors.
 
-Two modules, one contract (zero-cost when off, deterministic when on):
+Three modules, one contract (zero-cost when off, deterministic when on):
 
 - trace.py  Tracer/Span core: counter-based span ids, contextvars
             current-span propagation, a bounded ring of finished
@@ -12,20 +13,32 @@ Two modules, one contract (zero-cost when off, deterministic when on):
             identity).
 - prom.py   real Prometheus histograms (cumulative _bucket{le=...} /
             _sum / _count) for the engine and stream latencies,
-            rendered beside the existing gauges by node/metrics.py.
+            rendered beside the existing gauges by node/metrics.py —
+            plus exposition label escaping for the labeled families.
+- slo.py    the consumption layer: declarative SloTarget objectives
+            evaluated with observation-count multi-window burn-rate
+            detection, per-tenant x per-class accounting, and the
+            transition listeners serve/adaptive.py's admission
+            controller acts on. Gauges ride /metrics as cess_slo_* /
+            cess_tenant_*, snapshots serve the cess_sloStatus RPC.
 
-Wire-up: ``node.cli --trace[=PATH]``, ``serve.make_engine(tracer=...)``,
-``bench.py --trace``, and the ``cess_traceDump`` RPC.
+Wire-up: ``node.cli --trace[=PATH] --slo[=TARGETS]``,
+``serve.make_engine(tracer=..., slo=...)``, ``bench.py --trace``, and
+the ``cess_traceDump`` / ``cess_sloStatus`` RPCs.
 """
-from .prom import (LATENCY_BUCKETS_S, Histogram, format_le,
-                   render_histogram)
+from .prom import (LATENCY_BUCKETS_S, Histogram, escape_label,
+                   format_labels, format_le, render_histogram)
+from .slo import (DEFAULT_TARGETS, SloBoard, SloTarget, parse_targets)
 from .trace import (NOOP_SPAN, Span, Tracer, arm, armed, armed_tracer,
                     context, current_span, disarm, event, span)
 
 __all__ = [
+    "DEFAULT_TARGETS",
     "Histogram",
     "LATENCY_BUCKETS_S",
     "NOOP_SPAN",
+    "SloBoard",
+    "SloTarget",
     "Span",
     "Tracer",
     "arm",
@@ -34,8 +47,11 @@ __all__ = [
     "context",
     "current_span",
     "disarm",
+    "escape_label",
     "event",
+    "format_labels",
     "format_le",
+    "parse_targets",
     "render_histogram",
     "span",
 ]

@@ -101,13 +101,45 @@ def format_le(bound: float) -> str:
     return f"{bound:g}"
 
 
-def render_histogram(name: str, hist: Histogram) -> list[str]:
+def escape_label(value: str) -> str:
+    """Exposition-format label-value escaping (format 0.0.4): inside
+    the double quotes, backslash, double-quote and newline must be
+    escaped — tenant names are caller-supplied strings, and an
+    unescaped ``"`` would truncate the label and corrupt every sample
+    after it on the scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_labels(labels: dict | None) -> str:
+    """``{k="v",...}`` with escaped values (sorted: deterministic
+    exposition), or ``""`` for an unlabeled sample."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def render_histogram(name: str, hist: Histogram,
+                     labels: dict | None = None,
+                     type_line: bool = True) -> list[str]:
     """Text-exposition lines for one histogram family: the TYPE
-    declaration, cumulative buckets, ``_sum`` and ``_count``."""
+    declaration, cumulative buckets, ``_sum`` and ``_count``.
+
+    labels: extra labels on every sample (the per-tenant families —
+    ``le`` is merged in on the bucket lines). type_line=False skips
+    the ``# TYPE`` declaration: a labeled family renders one label-set
+    per call, but the exposition format allows exactly ONE TYPE line
+    per family, so the caller emits it for the first set only."""
     snap = hist.snapshot()
-    lines = [f"# TYPE {name} histogram"]
+    base = dict(labels or {})
+    lines = [f"# TYPE {name} histogram"] if type_line else []
     for bound, n in snap["buckets"]:
-        lines.append(f'{name}_bucket{{le="{format_le(bound)}"}} {n}')
-    lines.append(f"{name}_sum {snap['sum']}")
-    lines.append(f"{name}_count {snap['count']}")
+        lines.append(f"{name}_bucket"
+                     f"{format_labels({**base, 'le': format_le(bound)})}"
+                     f" {n}")
+    tail = format_labels(base)
+    lines.append(f"{name}_sum{tail} {snap['sum']}")
+    lines.append(f"{name}_count{tail} {snap['count']}")
     return lines

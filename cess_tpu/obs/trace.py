@@ -170,6 +170,11 @@ class Span:
             self.dur_s = dur
             if attrs:
                 self.attrs.update(attrs)
+            if len(self.tracer._spans) >= self.tracer.capacity:
+                # the bounded ring is about to evict its oldest
+                # finished span — count it (a silent wrap used to look
+                # identical to a quiet run in every export)
+                self.tracer._dropped += 1
             self.tracer._spans.append(self)
             token, self._token = self._token, None
         if token is not None:
@@ -216,6 +221,16 @@ class Tracer:
         self.origin = time.monotonic()   # ts origin for exports
         self.pid = os.getpid()
         self.started = 0                 # spans started (ever)
+        self._dropped = 0                # finished spans the ring evicted
+
+    @property
+    def dropped(self) -> int:
+        """Finished spans evicted by the bounded ring (capacity
+        overflow). A nonzero value means exports are a WINDOW, not the
+        whole run — exposed as ``cess_trace_spans_dropped_total`` on
+        /metrics so a wrapped ring is visible from the scrape."""
+        with self._mu:
+            return self._dropped
 
     # -- span creation -------------------------------------------------------
     def start(self, name: str, *, sys: str = "", parent=None,

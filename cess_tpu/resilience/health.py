@@ -63,13 +63,25 @@ class HealthMonitor:
         self._trips = 0
         self._probes = 0
         self._recoveries = 0
+        # external latch (the SLO admission controller's "SLO at
+        # risk" degrade, serve/adaptive.py): while held, the breaker
+        # is open with NO recovery probes — the device is not broken,
+        # it is being vacated, and only the holder may reopen it
+        self._held = False
+        self._hold_reason = ""
+        self._holds = 0
 
     # -- gating -------------------------------------------------------------
     def allow(self) -> bool:
         """May the next dispatch use the monitored backend? While the
         breaker is open, every ``probe_every``-th call is admitted as
-        a recovery probe (its outcome decides the state)."""
+        a recovery probe (its outcome decides the state) — unless the
+        open is an external HOLD, which admits nothing until
+        released (probing a healthy device the SLO controller is
+        deliberately vacating would defeat the vacating)."""
         with self._mu:
+            if self._held:
+                return False
             if self._state == "closed":
                 return True
             if self._probe_inflight:
@@ -114,6 +126,36 @@ class HealthMonitor:
         self._denied = 0
         self._outcomes.clear()
 
+    # -- external latch (SLO-gated degradation, serve/adaptive.py) ----------
+    def hold_open(self, reason: str = "held") -> None:
+        """Latch the breaker open under an external controller: every
+        dispatch degrades (no probes, no window-driven recovery) until
+        :meth:`release`. The PR-6 extension of "device broken" to "SLO
+        at risk" — the device stays healthy, the monitored backend is
+        being vacated for higher-priority traffic. Idempotent; a hold
+        over an already-tripped breaker just layers the latch (the
+        trip's own recovery resumes on release)."""
+        with self._mu:
+            if not self._held:
+                self._held = True
+                self._holds += 1
+            self._hold_reason = reason
+
+    def release(self) -> None:
+        """Drop the external latch. A breaker that was ALSO tripped by
+        its error window stays open and probes its way back (the hold
+        never masks a real failure); one opened purely by the hold
+        returns to closed with a fresh window."""
+        with self._mu:
+            if not self._held:
+                return
+            self._held = False
+            self._hold_reason = ""
+            if self._state == "closed":
+                self._outcomes.clear()
+                self._denied = 0
+                self._probe_inflight = False
+
     # -- manual control (bench/tests/ops) -----------------------------------
     def force_open(self) -> None:
         """Trip the breaker unconditionally (the bench's degraded-mode
@@ -124,6 +166,8 @@ class HealthMonitor:
 
     def force_close(self) -> None:
         with self._mu:
+            self._held = False
+            self._hold_reason = ""
             if self._state == "open":
                 self._state = "closed"
                 self._denied = 0
@@ -133,8 +177,12 @@ class HealthMonitor:
     # -- introspection ------------------------------------------------------
     @property
     def state(self) -> str:
+        """"closed" / "open" (window-tripped) / "held" (external
+        latch, serve/adaptive.py) — a held breaker reports held even
+        if its window also tripped, since only release() can admit
+        traffic again."""
         with self._mu:
-            return self._state
+            return "held" if self._held else self._state
 
     def snapshot(self) -> dict:
         with self._mu:
@@ -142,7 +190,9 @@ class HealthMonitor:
             errs = sum(1 for ok, _ in self._outcomes if not ok)
             lats = [t for ok, t in self._outcomes if ok]
             return {
-                "state": self._state,
+                "state": "held" if self._held else self._state,
+                "held_reason": self._hold_reason,
+                "holds": self._holds,
                 "trips": self._trips,
                 "probes": self._probes,
                 "recoveries": self._recoveries,

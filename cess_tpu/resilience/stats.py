@@ -79,8 +79,12 @@ class ResilienceStats:
                     float(snap[family][cls])
         for name in sorted(snap["breakers"]):
             b = snap["breakers"][name]
+            # "held" (the SLO controller's external latch) is open for
+            # traffic purposes: every dispatch degrades either way
             out[f"cess_resilience_breaker_{name}_open"] = \
-                1.0 if b["state"] == "open" else 0.0
+                1.0 if b["state"] != "closed" else 0.0
+            out[f"cess_resilience_breaker_{name}_held"] = \
+                1.0 if b["state"] == "held" else 0.0
             for k in ("trips", "probes", "recoveries"):
                 out[f"cess_resilience_breaker_{name}_{k}"] = float(b[k])
         return out

@@ -12,19 +12,29 @@ everywhere an engine is not explicitly configured.
 stream.py adds the double-buffered host->device streaming driver for
 the fused encode+tag workload (one H2D copy per batch, staging of
 batch i+1 overlapped with compute of batch i, ragged tail handled).
+
+adaptive.py closes the observability loop (ISSUE 6): per-class
+batching knobs tuned from the live latency signal
+(AdaptiveBatchPolicy) and SLO-gated, deadline-aware admission
+(AdmissionController) over an obs.SloBoard — opt-in via
+``make_engine(slo=..., adaptive=...)`` / ``node.cli --slo --adaptive``.
 """
+from .adaptive import AdaptiveBatchPolicy, AdmissionController
 from .engine import EngineFuture, SubmissionEngine, make_engine
 from .policy import (AdmissionPolicy, EngineClosed, EngineError,
-                     EngineSaturated, EngineTimeout)
+                     EngineSaturated, EngineShed, EngineTimeout)
 from .stats import EngineStats, StreamStats
 from .stream import StreamingIngest
 
 __all__ = [
+    "AdaptiveBatchPolicy",
+    "AdmissionController",
     "AdmissionPolicy",
     "EngineClosed",
     "EngineError",
     "EngineFuture",
     "EngineSaturated",
+    "EngineShed",
     "EngineStats",
     "EngineTimeout",
     "StreamStats",

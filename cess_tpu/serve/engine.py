@@ -60,6 +60,26 @@ Resilience (opt-in, cess_tpu/resilience): constructed with a
 The ``engine.dispatch`` fault site (resilience/faults.py) sits on
 every non-degraded device attempt, so seeded chaos plans can drive
 all of the above deterministically in tier-1.
+
+SLO + adaptive control (opt-in, ISSUE 6): built with an
+``obs.SloBoard`` (``slo=``) every resolved/failed/expired request
+feeds the board's burn-rate windows and per-tenant accounting (every
+submit takes an optional ``tenant=`` tag, threaded down from the
+gateway/miner/TEE agents), and the batcher's drain anchor becomes
+WEIGHTED-FAIR across tenants (deficit on served device rows) so one
+heavy uploader cannot starve another tenant's traffic inside a class.
+With an ``AdaptiveBatchPolicy`` (``adaptive=``) the batching knobs
+(max_delay / request / row budgets) are read PER CLASS from the live
+latency signal instead of the static policy constants, and with an
+``AdmissionController`` (``admission=``; auto-built by
+:func:`make_engine` when both are present) sheddable submits are
+SLO-gated (``EngineShed``) and a burning protected class latches the
+codec breaker open (``HealthMonitor.hold_open``) so bulk load
+degrades to the CPU reference while the device serves the protected
+class. All three attributes default to None and every hook on the
+disabled path is one attribute load + a None check — no SLO or
+tenant object is allocated (the NOOP_SPAN contract,
+tests/test_slo.py pins it).
 """
 from __future__ import annotations
 
@@ -80,7 +100,7 @@ from ..resilience import faults
 from ..resilience.retry import Budget
 from .buckets import ProgramCache, bucket_rows
 from .policy import (CLASSES, AdmissionPolicy, EngineClosed,
-                     EngineSaturated, EngineTimeout)
+                     EngineSaturated, EngineShed, EngineTimeout)
 from .stats import EngineStats
 
 
@@ -134,6 +154,10 @@ class _Request:
     # batch membership -> device dispatch -> resolve; the NOOP
     # singleton when no tracer is armed (every touch is then a no-op)
     span: Any = trace.NOOP_SPAN
+    # per-tenant accounting tag (obs/slo.py): None when untagged or
+    # when no SLO board is configured — a bare field default, nothing
+    # allocated on the disabled path
+    tenant: str | None = None
 
 
 def _round_digest(num_blocks: int, idx, nu) -> bytes:
@@ -186,7 +210,8 @@ class SubmissionEngine:
 
     def __init__(self, codec=None, audit=None,
                  policy: AdmissionPolicy | None = None,
-                 resilience=None, tracer=None):
+                 resilience=None, tracer=None, slo=None, adaptive=None,
+                 admission=None):
         if codec is None and audit is None:
             raise ValueError("engine needs a codec and/or audit backend")
         self.codec = codec
@@ -199,6 +224,18 @@ class SubmissionEngine:
         self.policy = policy or AdmissionPolicy()
         self.stats = EngineStats()
         self.programs = ProgramCache(self.stats)
+        # SLO + adaptive control (ISSUE 6, see module doc). All three
+        # default None: the disabled submit/batch paths are one
+        # attribute load + None check each, allocating nothing.
+        self.slo = slo                    # obs.SloBoard
+        self.adaptive = adaptive          # serve.adaptive.AdaptiveBatchPolicy
+        self.admission = admission        # serve.adaptive.AdmissionController
+        self.stats.slo = slo
+        self.stats.adaptive = adaptive
+        # per-(class, tenant) served device rows: the weighted-fair
+        # drain's deficit counters (engine-lock guarded, only ever
+        # populated when a board is configured)
+        self._tenant_rows: dict[str, dict[str, int]] = {}
         # resilience (cess_tpu/resilience, opt-in): CPU reference
         # fallbacks compute bit-identical bytes, so a tripped breaker
         # changes WHERE a batch runs, never what it returns
@@ -222,6 +259,11 @@ class SubmissionEngine:
                 self.monitors["audit"] = resilience.monitor()
             for name, mon in self.monitors.items():
                 resilience.stats.register_monitor(name, mon)
+        if admission is not None:
+            # after the monitors exist: the controller latches the
+            # codec breaker for its degrade response (no resilience =
+            # no breaker = shed-only admission)
+            admission.bind(self)
         self._queues: dict[str, collections.deque[_Request]] = {
             c: collections.deque() for c in CLASSES}
         self._lock = threading.Lock()
@@ -239,21 +281,25 @@ class SubmissionEngine:
     # ------------------------------------------------------------------
 
     # -- encode (ErasureCodec) ----------------------------------------
-    def submit_encode(self, data, timeout: float | None = None) -> EngineFuture:
+    def submit_encode(self, data, timeout: float | None = None,
+                      tenant: str | None = None) -> EngineFuture:
         """data [B, k, n] (or [k, n]) uint8 -> future of [B, k+m, n]."""
         self._need_codec()
         data, squeeze = self._norm_shards(data, self.codec.k)
         key = ("encode", data.shape[1], data.shape[2])
         return self._submit("encode", key, data.shape[0],
-                            {"data": data}, {}, timeout, squeeze)
+                            {"data": data}, {}, timeout, squeeze,
+                            tenant=tenant)
 
-    def encode(self, data, timeout: float | None = None) -> np.ndarray:
+    def encode(self, data, timeout: float | None = None,
+               tenant: str | None = None) -> np.ndarray:
         return self._blocking("encode", self.submit_encode, data,
-                              timeout=timeout)
+                              timeout=timeout, tenant=tenant)
 
     # -- decode / repair (ErasureCodec) --------------------------------
     def submit_reconstruct(self, survivors, present, missing=None,
-                           timeout: float | None = None) -> EngineFuture:
+                           timeout: float | None = None,
+                           tenant: str | None = None) -> EngineFuture:
         """survivors [B, k, n] (or [k, n]) rows ordered as ``present``
         -> future of the recovered [B, len(missing), n] shards."""
         self._need_codec()
@@ -267,32 +313,38 @@ class SubmissionEngine:
         return self._submit("repair", key, survivors.shape[0],
                             {"survivors": survivors},
                             {"present": present, "missing": tuple(missing)},
-                            timeout, squeeze)
+                            timeout, squeeze, tenant=tenant)
 
     def reconstruct(self, survivors, present, missing=None,
-                    timeout: float | None = None) -> np.ndarray:
+                    timeout: float | None = None,
+                    tenant: str | None = None) -> np.ndarray:
         return self._blocking("repair", self.submit_reconstruct,
                               survivors, present, missing,
-                              timeout=timeout)
+                              timeout=timeout, tenant=tenant)
 
     def submit_decode_data(self, survivors, present,
-                           timeout: float | None = None) -> EngineFuture:
+                           timeout: float | None = None,
+                           tenant: str | None = None) -> EngineFuture:
         self._need_codec()
         present = tuple(present)
         survivors, squeeze = self._norm_shards(survivors, len(present))
         key = ("repair", "decode", present, (), survivors.shape[2])
         return self._submit("repair", key, survivors.shape[0],
                             {"survivors": survivors},
-                            {"present": present}, timeout, squeeze)
+                            {"present": present}, timeout, squeeze,
+                            tenant=tenant)
 
     def decode_data(self, survivors, present,
-                    timeout: float | None = None) -> np.ndarray:
+                    timeout: float | None = None,
+                    tenant: str | None = None) -> np.ndarray:
         return self._blocking("repair", self.submit_decode_data,
-                              survivors, present, timeout=timeout)
+                              survivors, present, timeout=timeout,
+                              tenant=tenant)
 
     # -- tag (AuditBackend, TEE role) ----------------------------------
     def submit_tag(self, fragment_ids, fragments,
-                   timeout: float | None = None) -> EngineFuture:
+                   timeout: float | None = None,
+                   tenant: str | None = None) -> EngineFuture:
         """ids [F, 2] uint32, fragments [F, bytes] uint8 -> future of
         tags [F, blocks, limbs]."""
         self._need_audit()
@@ -303,17 +355,20 @@ class SubmissionEngine:
             raise ValueError("expected ids [F, 2] and fragments [F, bytes]")
         key = ("tag", frags.shape[1])
         return self._submit("tag", key, frags.shape[0],
-                            {"ids": ids, "fragments": frags}, {}, timeout)
+                            {"ids": ids, "fragments": frags}, {}, timeout,
+                            tenant=tenant)
 
     def tag_fragments(self, fragment_ids, fragments,
-                      timeout: float | None = None) -> np.ndarray:
+                      timeout: float | None = None,
+                      tenant: str | None = None) -> np.ndarray:
         return self._blocking("tag", self.submit_tag, fragment_ids,
-                              fragments, timeout=timeout)
+                              fragments, timeout=timeout, tenant=tenant)
 
     # -- prove (miner role) --------------------------------------------
     def submit_prove_aggregate(self, fragments, tags, idx, nu, r,
                                sectors: int | None = None,
-                               timeout: float | None = None) -> EngineFuture:
+                               timeout: float | None = None,
+                               tenant: str | None = None) -> EngineFuture:
         """One miner's aggregated proof over its held set: fragments
         [F, bytes], tags [F, blocks, limbs], coefficients r [F] ->
         future of (mu [sectors], sigma [limbs]). Requests from miners
@@ -340,19 +395,21 @@ class SubmissionEngine:
                             {"fragments": frags, "tags": tag_arr,
                              "r": r_arr},
                             {"idx": idx, "nu": nu, "sectors": sectors},
-                            timeout)
+                            timeout, tenant=tenant)
 
     def prove_aggregate(self, fragments, tags, idx, nu, r,
                         sectors: int | None = None,
-                        timeout: float | None = None):
+                        timeout: float | None = None,
+                        tenant: str | None = None):
         return self._blocking("prove", self.submit_prove_aggregate,
                               fragments, tags, idx, nu, r, sectors,
-                              timeout=timeout)
+                              timeout=timeout, tenant=tenant)
 
     # -- verify (TEE role) ---------------------------------------------
     def submit_verify_batch(self, fragment_ids, num_blocks, idx, nu,
                             mu, sigma,
-                            timeout: float | None = None) -> EngineFuture:
+                            timeout: float | None = None,
+                            tenant: str | None = None) -> EngineFuture:
         """Per-fragment checks: ids [F, 2], mu [F, sectors], sigma
         [F, limbs] -> future of bool [F]. Coalesces along F across
         requests of the same round."""
@@ -371,17 +428,20 @@ class SubmissionEngine:
         return self._submit("verify", key, ids.shape[0],
                             {"ids": ids, "mu": mu, "sigma": sigma},
                             {"idx": idx, "nu": nu,
-                             "num_blocks": num_blocks}, timeout)
+                             "num_blocks": num_blocks}, timeout,
+                            tenant=tenant)
 
     def verify_batch(self, fragment_ids, num_blocks, idx, nu, mu, sigma,
-                     timeout: float | None = None) -> np.ndarray:
+                     timeout: float | None = None,
+                     tenant: str | None = None) -> np.ndarray:
         return self._blocking("verify", self.submit_verify_batch,
                               fragment_ids, num_blocks, idx, nu, mu,
-                              sigma, timeout=timeout)
+                              sigma, timeout=timeout, tenant=tenant)
 
     def submit_verify_aggregate(self, fragment_ids, num_blocks, idx, nu,
                                 r, mu, sigma,
-                                timeout: float | None = None) -> EngineFuture:
+                                timeout: float | None = None,
+                                tenant: str | None = None) -> EngineFuture:
         """One aggregated-proof check (TeeAgent's per-mission verify):
         ids [F, 2], r [F], mu [sectors], sigma [limbs] -> future of
         bool. Missions of the same round coalesce: each mission's owed
@@ -405,13 +465,16 @@ class SubmissionEngine:
                             {"ids": ids, "r": r_arr, "mu": mu,
                              "sigma": sigma},
                             {"idx": idx, "nu": nu,
-                             "num_blocks": num_blocks}, timeout)
+                             "num_blocks": num_blocks}, timeout,
+                            tenant=tenant)
 
     def verify_aggregate(self, fragment_ids, num_blocks, idx, nu, r, mu,
-                         sigma, timeout: float | None = None) -> bool:
+                         sigma, timeout: float | None = None,
+                         tenant: str | None = None) -> bool:
         return bool(self._blocking(
             "verify", self.submit_verify_aggregate, fragment_ids,
-            num_blocks, idx, nu, r, mu, sigma, timeout=timeout))
+            num_blocks, idx, nu, r, mu, sigma, timeout=timeout,
+            tenant=tenant))
 
     # ------------------------------------------------------------------
     # lifecycle / introspection
@@ -485,6 +548,20 @@ class SubmissionEngine:
         consistently, so no engine lock is needed here."""
         return self.stats.histograms()
 
+    def labeled_series(self) -> list:
+        """Labeled exposition series — ``(family, kind, labels,
+        value)`` — from the SLO board (``cess_slo_*`` per-class gauges,
+        ``cess_tenant_*`` counters); empty without one. node/metrics.py
+        renders these beside the flat gauges with escaped label
+        values."""
+        return [] if self.slo is None else self.slo.series()
+
+    def labeled_histograms(self) -> list:
+        """Labeled histogram families — ``(family, labels,
+        Histogram)`` — the per-tenant latency distributions; empty
+        without an SLO board."""
+        return [] if self.slo is None else self.slo.tenant_histograms()
+
     def flush(self, timeout: float | None = None) -> bool:
         """Force-drain everything queued and wait until it resolves
         (no waiting out the coalescing delay). Returns False if the
@@ -539,23 +616,27 @@ class SubmissionEngine:
             raise ValueError("engine has no AuditBackend configured")
 
     def _blocking(self, cls: str, submit, *args,
-                  timeout: float | None = None):
+                  timeout: float | None = None,
+                  tenant: str | None = None):
         """The blocking convenience form behind encode()/tag_fragments()
         /... — without resilience it is submit().result() verbatim.
         With it, EngineSaturated submits retry under the configured
         backoff policy inside ONE deadline budget: every attempt's
         queue deadline and wait are the budget's REMAINING time, so
-        retrying can never extend the caller's deadline."""
+        retrying can never extend the caller's deadline. EngineShed is
+        deliberately NOT retried — shed load must stop offering, not
+        back off and re-offer (policy.py)."""
         res = self.resilience
         if res is None:
-            return submit(*args, timeout=timeout).result()
+            return submit(*args, timeout=timeout,
+                          tenant=tenant).result()
         if timeout is None:
             timeout = self.policy.default_timeout
         budget = Budget(timeout)
 
         def attempt(b):
             left = b.remaining()
-            return submit(*args, timeout=left).result(left)
+            return submit(*args, timeout=left, tenant=tenant).result(left)
 
         return res.retry.call(attempt, retry_on=(EngineSaturated,),
                               budget=budget, token=cls,
@@ -581,18 +662,32 @@ class SubmissionEngine:
 
     def _submit(self, cls: str, key: tuple, rows: int, arrays: dict,
                 aux: dict, timeout: float | None,
-                squeeze: bool = False) -> EngineFuture:
+                squeeze: bool = False,
+                tenant: str | None = None) -> EngineFuture:
         if rows < 1:
             raise ValueError(f"empty {cls} request (0 rows)")
         now = time.monotonic()
         if timeout is None:
             timeout = self.policy.default_timeout
+        # SLO-gated admission (serve/adaptive.py): consulted BEFORE
+        # anything is queued or allocated — a shed is an explicit
+        # EngineShed the caller acts on, never a silent drop. One
+        # attribute load + None check when no controller is configured.
+        adm = self.admission
+        if adm is not None:
+            reason = adm.admit(cls, timeout, tenant,
+                               queued=len(self._queues[cls]))
+            if reason is not None:
+                with self._lock:
+                    self.stats.classes[cls].shed += 1
+                raise EngineShed(f"{cls} request shed: {reason}")
         fut = EngineFuture()
         device = any(isinstance(a, jax.Array) for a in arrays.values())
         req = _Request(cls=cls, key=key, rows=rows, arrays=arrays,
                        aux=aux, enqueue_t=now,
                        deadline=None if timeout is None else now + timeout,
-                       future=fut, squeeze=squeeze, device=device)
+                       future=fut, squeeze=squeeze, device=device,
+                       tenant=tenant)
         tracer = self._tracer_now()
         if tracer is not None:
             # the request span outlives this frame (the batcher thread
@@ -601,6 +696,8 @@ class SubmissionEngine:
             req.span = tracer.start(  # cesslint: disable=span-balance — finished at resolve/reject/expire/close (cross-thread span)
                 f"engine.{cls}", sys="engine", cls=cls, rows=rows,
                 op=key[0])
+            if tenant is not None:
+                req.span.set(tenant=tenant)
         with self._cond:
             if self._closed:
                 req.span.set(outcome="closed").finish()
@@ -620,10 +717,13 @@ class SubmissionEngine:
     def _run(self) -> None:
         while True:
             batch: list[_Request] = []
+            breaches: list[tuple] = []
             with self._cond:
                 while True:
                     now = time.monotonic()
-                    self._expire(now)
+                    self._expire(now, breaches)
+                    if breaches:
+                        break
                     cls = self._ready_class(now)
                     if cls is not None:
                         batch = self._drain(cls)
@@ -633,6 +733,16 @@ class SubmissionEngine:
                         self._cond.notify_all()
                         return
                     self._cond.wait(self._wake_timeout(now))
+            if breaches:
+                # deadline breaches burn the SLO error budget — fed
+                # OUTSIDE the engine lock (board listeners may take
+                # breaker locks; same discipline as _account_batch).
+                # No batch was drained, so re-enter straight away.
+                slo = self.slo
+                for bcls, lat, tenant, rows in breaches:
+                    slo.observe(bcls, lat, ok=False, tenant=tenant,
+                                rows=rows)
+                continue
             try:
                 if batch:
                     self._run_batch(batch)
@@ -641,12 +751,28 @@ class SubmissionEngine:
                     self._inflight -= 1
                     self._cond.notify_all()
 
-    def _expire(self, now: float) -> None:
+    def _knobs(self, cls: str) -> tuple[float, int, int]:
+        """(max_delay, max_batch_requests, max_batch_rows) for this
+        class: the live AdaptiveBatchPolicy values when one is
+        configured, else the static policy constants — the one seam
+        through which adaptive control steers the batcher."""
+        ad = self.adaptive
+        if ad is not None:
+            return ad.knobs(cls)
+        pol = self.policy
+        return pol.max_delay, pol.max_batch_requests, pol.max_batch_rows
+
+    def _expire(self, now: float, breaches: list | None = None) -> None:
         """Cancel EVERY queued request whose deadline passed, in every
         class (lock held). Running before readiness checks means a dead
         request in a quiet class cancels promptly even while other
         classes carry traffic, never trips a spurious drain trigger,
-        and stops counting against its queue's cap."""
+        and stops counting against its queue's cap. A timed-out
+        request IS an SLO breach (the budget burns whether the device
+        ran or not), but the board must never be fed under the engine
+        lock — breaches are collected into ``breaches`` for the
+        caller to observe after releasing it."""
+        slo = self.slo
         for cls, q in self._queues.items():
             if not any(r.deadline is not None and r.deadline <= now
                        for r in q):
@@ -660,6 +786,9 @@ class SubmissionEngine:
                         f"{cls} request deadline expired before "
                         "batching"))
                     r.span.set(outcome="timeout").finish()
+                    if slo is not None and breaches is not None:
+                        breaches.append((cls, now - r.enqueue_t,
+                                         r.tenant, r.rows))
                 else:
                     keep.append(r)
             q.clear()
@@ -669,15 +798,14 @@ class SubmissionEngine:
         """Class to drain now, or None to keep waiting.
 
         A drain happens when ANY class trips a trigger — size
-        (requests or rows), deadline (oldest waited max_delay), an
-        active flush, or engine shutdown (drain everything). Once the
-        device is going to be fed, the HIGHEST-PRIORITY non-empty
-        class goes first regardless of which class tripped: a
-        just-arrived challenge verification preempts the bulk encode
-        whose delay expired (policy.py). Expired requests are gone
-        already (_expire runs first), so deadlines never trigger
-        drains."""
-        pol = self.policy
+        (requests or rows), deadline (oldest waited its class's
+        max_delay), an active flush, or engine shutdown (drain
+        everything). Once the device is going to be fed, the
+        HIGHEST-PRIORITY non-empty class goes first regardless of
+        which class tripped: a just-arrived challenge verification
+        preempts the bulk encode whose delay expired (policy.py).
+        Expired requests are gone already (_expire runs first), so
+        deadlines never trigger drains."""
         first_nonempty = None
         for cls in CLASSES:               # priority order
             q = self._queues[cls]
@@ -685,18 +813,22 @@ class SubmissionEngine:
                 continue
             if first_nonempty is None:
                 first_nonempty = cls
+            max_delay, max_reqs, max_rows = self._knobs(cls)
             if (self._closed or self._flushing
-                    or len(q) >= pol.max_batch_requests
-                    or q[0].enqueue_t + pol.max_delay <= now
-                    or sum(r.rows for r in q) >= pol.max_batch_rows):
+                    or len(q) >= max_reqs
+                    or q[0].enqueue_t + max_delay <= now
+                    or sum(r.rows for r in q) >= max_rows):
                 return first_nonempty
         return None
 
     def _wake_timeout(self, now: float) -> float | None:
         wake = None
-        for q in self._queues.values():
+        for cls, q in self._queues.items():
+            if not q:
+                continue
+            max_delay = self._knobs(cls)[0]
             for r in q:
-                t = r.enqueue_t + self.policy.max_delay
+                t = r.enqueue_t + max_delay
                 if r.deadline is not None:
                     t = min(t, r.deadline)
                 wake = t if wake is None else min(wake, t)
@@ -710,24 +842,68 @@ class SubmissionEngine:
     _STACKED_OPS = ("prove", "verify_agg")
     PAD_SPREAD = 4
 
+    def _anchor_index(self, cls: str, q) -> int:
+        """Which queued request anchors the next batch. Without tenant
+        accounting: the oldest (index 0, the PR-1 behavior). With an
+        SLO board: weighted-fair across the tenants present in the
+        queue — the anchor is the OLDEST request of the tenant with
+        the smallest served-device-rows deficit counter, so a heavy
+        uploader's backlog cannot indefinitely pre-empt another
+        tenant's differently-keyed work inside the same class (ties
+        break lexicographically: deterministic). Lock held."""
+        if self.slo is None or len(q) < 2:
+            return 0
+        served = self._tenant_rows.get(cls, {})
+        first_of: dict[str, int] = {}
+        for i, r in enumerate(q):
+            t = self._fair_key(r.tenant, served)
+            if t not in first_of:
+                first_of[t] = i
+        if len(first_of) < 2:
+            return 0
+        tenant = min(first_of, key=lambda t: (served.get(t, 0), t))
+        return first_of[tenant]
+
+    def _fair_key(self, tenant: "str | None", served: dict) -> str:
+        """Deficit-counter key for a tenant: its own name while
+        in-cap, the board's shared overflow bucket once the board's
+        ``max_tenants`` distinct names exist (same cap and same
+        bucket as the ``cess_tenant_*`` exposition, so the scrape can
+        explain the scheduler's grouping). The ONE aliasing rule for
+        both sides — _account_batch charges served rows under it and
+        _anchor_index reads deficits through it; a divergence inverts
+        fairness (an over-cap tenant whose charges land in the
+        overflow but whose raw name reads 0 anchors every drain)."""
+        t = tenant or ""
+        if t not in served and len(served) >= self.slo.max_tenants:
+            from ..obs.slo import OVERFLOW
+
+            return OVERFLOW
+        return t
+
     def _drain(self, cls: str) -> list[_Request]:
         """Pop one coalescible batch (lock held): take queued requests
-        sharing the oldest request's key up to the size budgets;
-        others stay queued. Expired requests are already gone
-        (_expire runs under the same lock hold)."""
+        sharing the ANCHOR request's key up to the size budgets;
+        others stay queued in order. The anchor is the oldest request
+        (or the fair-queued tenant's oldest — _anchor_index). Expired
+        requests are already gone (_expire runs under the same lock
+        hold)."""
         q = self._queues[cls]
         if not q:
             return []
-        first = q[0]
+        idx = self._anchor_index(cls, q)
+        first = q[idx]
         stacked = first.key[0] in self._STACKED_OPS
         anchor_bucket = bucket_rows(first.rows)
-        batch, rest, rows = [], [], 0
-        for r in q:
-            fits = (not batch
-                    or (r.key == first.key
-                        and len(batch) < self.policy.max_batch_requests
-                        and rows + r.rows <= self.policy.max_batch_rows))
-            if fits and stacked and batch:
+        _, max_reqs, max_rows = self._knobs(cls)
+        batch, rest, rows = [first], [], first.rows
+        for i, r in enumerate(q):
+            if i == idx:
+                continue
+            fits = (r.key == first.key
+                    and len(batch) < max_reqs
+                    and rows + r.rows <= max_rows)
+            if fits and stacked:
                 b = bucket_rows(r.rows)
                 fits = (b <= self.PAD_SPREAD * anchor_bucket
                         and anchor_bucket <= self.PAD_SPREAD * b)
@@ -798,9 +974,11 @@ class SubmissionEngine:
                 return
             with self._lock:
                 self.stats.classes[cls].failed += len(batch)
+            fail_t = time.monotonic()
             for r in batch:
                 r.future._reject(e)
                 r.span.set(outcome="error", error=repr(e)).finish()
+                self._observe_failure(r, fail_t)
             return
         if mon is not None and not degraded:
             mon.record_success(time.monotonic() - t0)
@@ -810,6 +988,14 @@ class SubmissionEngine:
             r.future._resolve(out)
             if r.span is not trace.NOOP_SPAN:
                 r.span.set(outcome="ok").finish()
+
+    def _observe_failure(self, r: _Request, now: float) -> None:
+        """Feed one rejected request into the SLO windows (failures
+        burn the error budget). One None check on the disabled path."""
+        slo = self.slo
+        if slo is not None:
+            slo.observe(r.cls, now - r.enqueue_t, ok=False,
+                        tenant=r.tenant, rows=r.rows)
 
     def _account_batch(self, batch: list[_Request], device_rows: int,
                        batch_span=trace.NOOP_SPAN) -> None:
@@ -827,9 +1013,30 @@ class SubmissionEngine:
                 lat = done - r.enqueue_t
                 st.latencies.append(lat)
                 st.hist.observe(lat)
+            if self.slo is not None:
+                # the weighted-fair drain's deficit counters (bounded:
+                # past the cap a new tenant shares the overflow bucket)
+                served = self._tenant_rows.setdefault(cls, {})
+                for r in batch:
+                    t = self._fair_key(r.tenant, served)
+                    served[t] = served.get(t, 0) + r.rows
+        # SLO + adaptive feeds OUTSIDE the engine lock (board and
+        # policy own their locks; listeners may touch breaker locks) —
+        # and only when armed: the disabled path pays one attribute
+        # load + None check per batch, allocating nothing (the
+        # zero-cost-when-off contract, cess_tpu/obs)
+        slo = self.slo
+        if slo is not None:
+            for r in batch:
+                slo.observe(cls, done - r.enqueue_t, ok=True,
+                            tenant=r.tenant, rows=r.rows)
+        ad = self.adaptive
+        if ad is not None:
+            occ = len(batch)
+            for r in batch:
+                ad.note(cls, done - r.enqueue_t, occ)
         # span attribution only when the spans are real: the disabled
         # path must not pay the round()s / kwargs dicts per request
-        # (the zero-cost-when-off contract, cess_tpu/obs)
         if batch_span is not trace.NOOP_SPAN:
             pad = max(device_rows - real_rows, 0)
             pad_waste = pad / device_rows if device_rows else 0.0
@@ -896,6 +1103,7 @@ class SubmissionEngine:
                     self.stats.classes[cls].failed += 1
                 r.future._reject(exc)
                 r.span.set(outcome="error", error=repr(exc)).finish()
+                self._observe_failure(r, time.monotonic())
             else:
                 self._account_batch([r], rows)
                 r.future._resolve(out[0])
@@ -1087,7 +1295,8 @@ def make_engine(k: int | None = None, m: int | None = None, *,
                 rs_backend: str = "cpu", strategy: str | None = None,
                 podr2_key=None, audit_backend: str = "cpu",
                 policy: AdmissionPolicy | None = None,
-                resilience=None, tracer=None) -> SubmissionEngine:
+                resilience=None, tracer=None, slo=None, adaptive=None,
+                admission=None) -> SubmissionEngine:
     """Build an engine over the two trait gates.
 
     k/m select the ErasureCodec geometry (None = no codec: the engine
@@ -1100,6 +1309,13 @@ def make_engine(k: int | None = None, m: int | None = None, *,
     every submit (queue-wait -> batch -> device dispatch -> resolve);
     without one the engine still honors a process-armed tracer
     (obs.trace.arm), and with neither every hook is a no-op.
+    slo: optional cess_tpu.obs.SloBoard — burn-rate SLO monitors +
+    per-tenant accounting + weighted-fair dequeue (module doc's SLO
+    paragraph). adaptive: an AdaptiveBatchPolicy (serve/adaptive.py),
+    or True to build one seeded from ``policy`` and steered by the
+    board's targets. admission: an AdmissionController; auto-built
+    when both ``slo`` and ``adaptive`` are present (pass your own to
+    customize the protect/shed classes, or ``False`` to disable).
     """
     codec = None
     if k is not None:
@@ -1111,5 +1327,21 @@ def make_engine(k: int | None = None, m: int | None = None, *,
         from ..ops import audit_backend as ab
 
         audit = ab.make_audit_backend(podr2_key, audit_backend)
+    if adaptive is True:
+        if slo is None:
+            # the node.cli refusal, enforced at the API layer too: a
+            # tuner with no board has no targets to steer toward and
+            # would silently never adjust a knob (pass an explicit
+            # AdaptiveBatchPolicy(targets=...) for a board-less tuner)
+            raise ValueError("adaptive=True needs an slo= board "
+                             "(its targets steer the knob tuner)")
+        from .adaptive import AdaptiveBatchPolicy
+
+        adaptive = AdaptiveBatchPolicy(policy, board=slo)
+    if admission is None and slo is not None and adaptive is not None:
+        from .adaptive import AdmissionController
+
+        admission = AdmissionController(slo, adaptive)
     return SubmissionEngine(codec, audit, policy, resilience=resilience,
-                            tracer=tracer)
+                            tracer=tracer, slo=slo, adaptive=adaptive,
+                            admission=admission or None)

@@ -36,6 +36,16 @@ class EngineTimeout(EngineError):
     """The request's deadline expired before its batch ran."""
 
 
+class EngineShed(EngineError):
+    """The request was rejected by SLO-gated admission control
+    (serve/adaptive.py): either a protected class's SLO is burning and
+    this class is being shed to protect it, or the request's own
+    deadline is already below the class's live p99 estimate. Distinct
+    from :class:`EngineSaturated` on purpose — a saturated queue wants
+    a backoff-retry, shed load wants the caller to STOP offering
+    (route to the direct path, or wait for the SLO to recover)."""
+
+
 class EngineClosed(EngineError):
     """Submit against an engine that has been shut down."""
 

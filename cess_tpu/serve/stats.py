@@ -23,8 +23,8 @@ LATENCY_WINDOW = 512     # per-class sliding window for percentiles
 
 class ClassStats:
     __slots__ = ("submitted", "completed", "failed", "timeouts",
-                 "saturated", "batches", "batched_requests", "rows",
-                 "padded_rows", "latencies", "hist")
+                 "saturated", "shed", "batches", "batched_requests",
+                 "rows", "padded_rows", "latencies", "hist")
 
     def __init__(self):
         self.submitted = 0          # requests admitted to the queue
@@ -32,6 +32,7 @@ class ClassStats:
         self.failed = 0             # futures resolved with an op error
         self.timeouts = 0           # cancelled: deadline expired queued
         self.saturated = 0          # rejected at submit: queue full
+        self.shed = 0               # rejected by SLO-gated admission
         self.batches = 0            # device batches launched
         self.batched_requests = 0   # requests across those batches
         self.rows = 0               # real rows across those batches
@@ -133,6 +134,12 @@ class EngineStats:
         # engine is resilience-configured — duck-typed (snapshot()/
         # metrics()) so this module never imports the package
         self.resilience = None
+        # SloBoard (obs/slo.py) / AdaptiveBatchPolicy (serve/
+        # adaptive.py) when configured — same duck-typed contract;
+        # the board's LABELED families render via the engine's
+        # labeled_series()/labeled_histograms(), not these flat dicts
+        self.slo = None
+        self.adaptive = None
 
     def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
         """JSON-shaped dump for the RPC debug endpoint."""
@@ -148,6 +155,7 @@ class EngineStats:
                 "failed": st.failed,
                 "timeouts": st.timeouts,
                 "saturated": st.saturated,
+                "shed": st.shed,
                 "batches": st.batches,
                 "batch_occupancy": round(st.occupancy, 4),
                 "pad_waste": round(st.pad_waste, 4),
@@ -158,6 +166,10 @@ class EngineStats:
             out["streams"] = [s.snapshot() for s in self.streams]
         if self.resilience is not None:
             out["resilience"] = self.resilience.snapshot()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        if self.adaptive is not None:
+            out["adaptive"] = self.adaptive.snapshot()
         return out
 
     def metrics(self, queue_depths: dict[str, int] | None = None
@@ -182,6 +194,9 @@ class EngineStats:
             # cess_resilience_* rides the same exposition (ISSUE 4:
             # retry/abandon/breaker gauges beside the engine family)
             out.update(self.resilience.metrics())
+        if self.adaptive is not None:
+            # cess_adaptive_* per-class knob/estimate gauges (ISSUE 6)
+            out.update(self.adaptive.metrics())
         return out
 
     def histograms(self) -> dict[str, prom.Histogram]:

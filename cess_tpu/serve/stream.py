@@ -111,11 +111,16 @@ class StreamingIngest:
     put / put_ids: override staging (default jax.device_put) — the
               mesh entry passes sharded placements.
     engine:   optional SubmissionEngine to export stats through.
+    tenant:   optional per-tenant accounting tag (obs/slo.py): with an
+              attached engine carrying an SLO board, each staged batch
+              is charged to this tenant under the ``stream`` class —
+              the gateway ingest path's contribution to the same
+              accounting its engine submits carry.
     """
 
     def __init__(self, pipeline, batch: int, *, depth: int = 2,
                  program=None, put=None, put_ids=None, stats=None,
-                 engine=None):
+                 engine=None, tenant: str | None = None):
         if batch < 1 or depth < 1:
             raise ValueError(f"bad stream shape: batch={batch}, "
                              f"depth={depth}")
@@ -123,6 +128,7 @@ class StreamingIngest:
         self.batch = batch
         self.depth = depth
         self.stats = stats or StreamStats()
+        self.tenant = tenant
         self._program = program
         self._put = put or jax.device_put
         self._put_ids = put_ids or self._put
@@ -249,7 +255,7 @@ class StreamingIngest:
                                       parent=run_span, rows=real,
                                       pad=pad)
                 try:
-                    t0 = time.perf_counter()
+                    bt0 = t0 = time.perf_counter()
                     faults.inject("stream.h2d")   # chaos seam: staging
                     dev = self._put(chunk)
                     ids_dev = self._put_ids(ids)
@@ -267,13 +273,30 @@ class StreamingIngest:
                     # a staging/dispatch failure (fault injection, OOM)
                     # must still land the batch span in the ring, error
                     # attached — a traced chaos run shows WHICH batch
-                    # died, not a silent hole in the export
+                    # died, not a silent hole in the export — and burn
+                    # the stream SLO's error budget like any engine
+                    # failure (_observe_failure): a stream that died
+                    # must not scrape as a clean SLO
                     if bspan is not trace.NOOP_SPAN:
                         bspan.set(error=repr(e)).finish()
+                    eng = self._engine
+                    if eng is not None and eng.slo is not None:
+                        eng.slo.observe("stream",
+                                        time.perf_counter() - bt0,
+                                        ok=False, tenant=self.tenant,
+                                        rows=real)
                     raise
                 dispatch = time.perf_counter() - t0
                 st.dispatch_s += dispatch
                 st.hist.observe(h2d + dispatch)
+                # SLO/tenant feed (obs/slo.py): streamed batches ride
+                # the attached engine's board under the "stream" class
+                # (targetable like any op class); one attribute chain
+                # + None check when no board is configured
+                eng = self._engine
+                if eng is not None and eng.slo is not None:
+                    eng.slo.observe("stream", h2d + dispatch,
+                                    tenant=self.tenant, rows=real)
                 if bspan is not trace.NOOP_SPAN:
                     bspan.finish(h2d_s=round(h2d, 6),
                                  dispatch_s=round(dispatch, 6))

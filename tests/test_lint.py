@@ -377,6 +377,33 @@ class TestLockDiscipline:
         assert "trace-print" in rules_at(
             lint(DIRTY_TRACE, "cess_tpu/obs/fixture.py"))
 
+    def test_slo_and_adaptive_layers_are_clean(self):
+        """ISSUE 6 satellite: the new SLO board (obs/slo.py — burn
+        windows + tenant counters hit from batcher, submitter AND
+        scrape threads) and the adaptive control plane
+        (serve/adaptive.py — knobs read under the engine lock,
+        listeners touching breaker locks) pass the trace-safety,
+        lock-discipline and span-balance families with zero findings
+        and zero suppressions; the baseline stays empty."""
+        paths = [os.path.join(REPO, "cess_tpu", "obs", "slo.py"),
+                 os.path.join(REPO, "cess_tpu", "serve", "adaptive.py")]
+        r = analysis.lint_paths(paths, root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        # every family really applies at both paths (dirty fixtures
+        # fire there), so the clean scan above is meaningful
+        for fixture_path in ("cess_tpu/obs/slo.py",
+                             "cess_tpu/serve/adaptive.py"):
+            assert "lock-unguarded-write" in rules_at(
+                lint(DIRTY_LOCK, fixture_path))
+            assert "trace-print" in rules_at(
+                lint(DIRTY_TRACE, fixture_path))
+            assert "span-balance" in rules_at(
+                lint(DIRTY_SPAN, fixture_path))
+        baseline = analysis.load_baseline(BASELINE)
+        assert baseline == {}
+
 
 # ---------------------------------------------------------------------------
 # span balance (tracing discipline, ISSUE 5)
@@ -434,9 +461,14 @@ class TestSpanBalance:
         r = lint(CLEAN_SPAN, "cess_tpu/serve/fixture.py")
         assert r.findings == [] and r.suppressed == []
 
-    def test_obs_package_itself_is_exempt(self):
-        r = lint(DIRTY_SPAN, "cess_tpu/obs/fixture.py")
+    def test_only_the_trace_implementation_is_exempt(self):
+        # the exemption is exactly obs/trace.py (the implementation
+        # being wrapped); the rest of obs/ — slo.py is a CONSUMER of
+        # spans — is scanned like everything else (ISSUE 6)
+        r = lint(DIRTY_SPAN, "cess_tpu/obs/trace.py")
         assert "span-balance" not in rules_at(r)
+        r = lint(DIRTY_SPAN, "cess_tpu/obs/slo.py")
+        assert "span-balance" in rules_at(r)
 
     def test_cross_thread_spans_carry_justified_suppressions(self):
         """The engine's request/batch spans legitimately outlive their

@@ -310,3 +310,108 @@ class TestChromeExport:
         assert req[0]["args"]["batch_span"] \
             == batch[0]["args"]["span_id"]
         assert batch[0]["args"]["parent"] == req[0]["args"]["span_id"]
+
+
+# -- ISSUE 6 satellites: merge error path, label escaping, ring drops --------
+class TestHistogramMergeBounds:
+    def test_differing_bounds_refuse_to_merge(self):
+        """The non-exact-merge error path: a merge across differing
+        bucket bounds would fabricate counts — it must raise, name
+        both bound sets, and leave the target histogram untouched."""
+        a = obs.Histogram((0.01, 0.1, 1.0))
+        b = obs.Histogram((0.01, 0.5, 1.0))     # same len, diff bound
+        a.observe(0.05)
+        b.observe(0.05)
+        with pytest.raises(ValueError) as exc:
+            a.merge(b)
+        assert "0.5" in str(exc.value) and "0.1" in str(exc.value)
+        assert a.snapshot()["count"] == 1       # untouched by the miss
+        # subset/superset bounds are just as unmergeable as same-length
+        with pytest.raises(ValueError):
+            a.merge(obs.Histogram((0.01, 0.1, 1.0, 2.0)))
+        with pytest.raises(ValueError):
+            obs.Histogram((0.01, 0.1, 1.0, 2.0)).merge(a)
+        # and identical bounds still merge exactly
+        a.merge(obs.Histogram((0.01, 0.1, 1.0)))
+        assert a.snapshot()["count"] == 1
+
+
+class TestLabelEscaping:
+    def test_escape_label_rules(self):
+        assert obs.escape_label('plain') == 'plain'
+        assert obs.escape_label('a"b') == 'a\\"b'
+        assert obs.escape_label('a\\b') == 'a\\\\b'
+        assert obs.escape_label('a\nb') == 'a\\nb'
+        # escaping order: backslashes first, so an escaped quote's
+        # backslash is not double-escaped
+        assert obs.escape_label('\\"') == '\\\\\\"'
+
+    def test_tenant_names_with_quotes_and_backslashes_render(self):
+        """A tenant named with `"` and `\\` must not truncate the
+        label or corrupt the exposition — the whole scrape still
+        parses line by line."""
+        from cess_tpu.obs.slo import SloBoard, SloTarget
+        from cess_tpu.serve import make_engine
+
+        node = Node(dev_spec(), "esc-node", {})
+        board = SloBoard((SloTarget("encode", 1.0),))
+        engine = make_engine(K, M, policy=AdmissionPolicy(max_delay=0.002),
+                             slo=board)
+        node.engine = engine
+        evil = 'ten"ant\\7'
+        try:
+            rng = np.random.default_rng(8)
+            engine.encode(rng.integers(0, 256, (2, K, 64),
+                                       dtype=np.uint8), tenant=evil)
+            text = render_metrics(node)
+        finally:
+            engine.close()
+        types, samples = parse_exposition(text)
+        # the labeled families parse and carry the escaped value
+        tenant_samples = [(n, l, v) for n, l, v in samples
+                          if l.get("tenant")]
+        assert tenant_samples, "no tenant-labeled samples rendered"
+        assert all(l["tenant"] == 'ten\\"ant\\\\7'
+                   for _, l, _ in tenant_samples)
+        # exactly ONE TYPE line per labeled family (the parser raises
+        # on duplicates, but pin the families we expect)
+        for fam in ("cess_tenant_requests_total",
+                    "cess_tenant_latency_seconds"):
+            assert fam in types
+        assert types["cess_tenant_requests_total"] == "counter"
+        assert types["cess_tenant_latency_seconds"] == "histogram"
+        # histogram invariants hold for the labeled family too
+        buckets = [v for n, l, v in samples
+                   if n == "cess_tenant_latency_seconds_bucket"]
+        assert buckets == sorted(buckets)
+        count = next(v for n, l, v in samples
+                     if n == "cess_tenant_latency_seconds_count")
+        assert buckets[-1] == count >= 1
+
+
+class TestTracerRingDrops:
+    def test_overflowing_a_small_ring_counts_drops(self):
+        """ISSUE 6 satellite: finished spans evicted by the bounded
+        ring used to vanish silently — the Tracer now counts them."""
+        tracer = obs.Tracer(capacity=4)
+        assert tracer.dropped == 0
+        for i in range(10):
+            tracer.start(f"s{i}").finish()
+        assert tracer.dropped == 6              # 10 finished, 4 kept
+        assert len(tracer.finished()) == 4
+        # and the count rides the node exposition as a counter
+        node = Node(dev_spec(), "drop-node", {})
+        node.tracer = tracer
+        m = collect(node)
+        assert m["cess_trace_spans_dropped_total"] == 6.0
+        types, _ = parse_exposition(render_metrics(node))
+        assert types["cess_trace_spans_dropped_total"] == "counter"
+
+    def test_armed_tracer_serves_the_counter_without_a_pinned_one(self):
+        node = Node(dev_spec(), "drop-node2", {})
+        assert "cess_trace_spans_dropped_total" not in collect(node)
+        with obs.armed(obs.Tracer(capacity=2)) as tracer:
+            for i in range(5):
+                tracer.start(f"a{i}").finish()
+            assert collect(node)["cess_trace_spans_dropped_total"] == 3.0
+        assert "cess_trace_spans_dropped_total" not in collect(node)

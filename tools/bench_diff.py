@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""bench_diff: the perf-trajectory regression gate.
+
+Compares two bench records — by default the newest ``BENCH_r*.json``
+in the repo root against the previous round — and prints a per-metric
+delta table. Exits non-zero when any metric regressed past the
+threshold, so CI can gate merges on the measured trajectory instead
+of trusting the green "vs_baseline" flag (VERDICT r4 Weak #1: a -26%
+podr2 move hid inside a passing target for a whole round).
+
+Record formats accepted, in order of preference:
+- the driver's round wrapper: a JSON object whose ``tail`` field holds
+  ``bench.py``'s stdout (the checked-in BENCH_r*.json shape);
+- raw ``bench.py`` output: JSON lines, one ``{"metric": ..., "value":
+  ...}`` object per line.
+
+Direction is inferred from the unit of record: ``*_ms`` metrics are
+latencies (lower is better), everything else is a rate (higher is
+better). A missing metric on either side is reported but never fails
+the gate (new metrics appear every round by design).
+
+    python tools/bench_diff.py                         # newest vs previous
+    python tools/bench_diff.py BENCH_r06.json --against BENCH_r05.json
+    python tools/bench_diff.py current.jsonl --threshold 5 --json
+
+Exit codes: 0 ok, 1 regression(s) past threshold, 2 usage/load error.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_record(path: str) -> dict[str, float]:
+    """{metric: value} from a round wrapper or raw JSONL file."""
+    with open(path) as f:
+        text = f.read()
+    lines = text
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict) and "tail" in obj:
+            lines = obj["tail"]
+    except ValueError:
+        pass             # raw JSONL: parse line by line below
+    out: dict[str, float] = {}
+    for line in lines.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "metric" in d and "value" in d:
+            out[d["metric"]] = float(d["value"])
+    if not out:
+        raise ValueError(f"no metric lines found in {path}")
+    return out
+
+
+def round_of(path: str) -> int:
+    """Round number of a BENCH_r*.json path, or -1 for anything else."""
+    m = re.search(r"BENCH_r0*(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def newest_rounds() -> list[str]:
+    """BENCH_r*.json paths in the repo root, newest round first."""
+    paths = [p for p in glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+             if round_of(p) >= 0]
+    return sorted(paths, key=round_of, reverse=True)
+
+
+def lower_is_better(metric: str) -> bool:
+    return metric.endswith("_ms")
+
+
+def diff(prev: dict[str, float], cur: dict[str, float],
+         threshold_pct: float) -> dict:
+    """Per-metric deltas + the regression verdict. ``delta_pct`` is
+    signed raw change; ``regression_pct`` is how much the metric moved
+    in its BAD direction (0.0 when it improved)."""
+    rows = []
+    for metric in sorted(set(prev) | set(cur)):
+        if metric not in prev or metric not in cur:
+            rows.append({"metric": metric,
+                         "prev": prev.get(metric),
+                         "cur": cur.get(metric),
+                         "delta_pct": None, "regression_pct": 0.0,
+                         "note": "only in "
+                                 + ("current" if metric in cur
+                                    else "previous")})
+            continue
+        p, c = prev[metric], cur[metric]
+        delta = 100.0 * (c - p) / p if p else 0.0
+        # the bad direction: an increase for latencies, a drop for rates
+        bad = delta if lower_is_better(metric) else -delta
+        rows.append({"metric": metric, "prev": p, "cur": c,
+                     "delta_pct": round(delta, 2),
+                     "regression_pct": round(max(bad, 0.0), 2)})
+    regressions = [r for r in rows
+                   if r["regression_pct"] > threshold_pct]
+    return {"threshold_pct": threshold_pct, "rows": rows,
+            "regressions": [r["metric"] for r in regressions]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_diff",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("current", nargs="?", default=None,
+                    help="current record (default: newest BENCH_r*.json)")
+    ap.add_argument("--against", default=None,
+                    help="previous record (default: the round before "
+                         "the current one)")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression percentage that fails the gate "
+                         "(default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    rounds = newest_rounds()
+    current = args.current
+    against = args.against
+    if current is None:
+        if not rounds:
+            print("no BENCH_r*.json records found and no current "
+                  "record given", file=sys.stderr)
+            return 2
+        current = rounds[0]
+    if against is None:
+        # "the round before the current one": for a BENCH_r* current,
+        # only LOWER round numbers qualify — diffing an old record
+        # against a newer one would invert the timeline and report
+        # later improvements as regressions
+        cur_round = round_of(current)
+        earlier = [p for p in rounds
+                   if os.path.abspath(p) != os.path.abspath(current)
+                   and (cur_round < 0 or round_of(p) < cur_round)]
+        if not earlier:
+            print("no previous round to diff against (pass --against)",
+                  file=sys.stderr)
+            return 2
+        against = earlier[0]     # newest-first => the next-lower round
+    try:
+        prev = load_record(against)
+        cur = load_record(current)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+
+    report = diff(prev, cur, args.threshold)
+    report["current"] = os.path.basename(current)
+    report["against"] = os.path.basename(against)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"bench_diff: {report['against']} -> "
+              f"{report['current']} (threshold "
+              f"{args.threshold:g}%)")
+        for r in report["rows"]:
+            if r["delta_pct"] is None:
+                print(f"  {r['metric']:45s} {r['note']}")
+                continue
+            arrow = "lower=better" if lower_is_better(r["metric"]) \
+                else "higher=better"
+            flag = "  REGRESSION" \
+                if r["regression_pct"] > args.threshold else ""
+            print(f"  {r['metric']:45s} {r['prev']:>12g} -> "
+                  f"{r['cur']:>12g}  {r['delta_pct']:+7.2f}%  "
+                  f"({arrow}){flag}")
+        if report["regressions"]:
+            print(f"FAIL: {len(report['regressions'])} metric(s) "
+                  f"regressed past {args.threshold:g}%: "
+                  + ", ".join(report["regressions"]))
+        else:
+            print("OK: no regression past threshold")
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
