@@ -559,6 +559,41 @@ def bench_podr2(jnp, jax, resident, frag_size, total, verify_chunk):
                   + verify_t / (3 * vwin * verify_chunk))
 
 
+def bench_sim(n_nodes: int, rounds_warm: int = 2):
+    """sim_500node_round_drain_s: wall seconds to drain ONE virtual
+    round of the deterministic discrete-event sim (cess_tpu/sim) at
+    ``n_nodes``, under the churn+partition stress shape — one crashed
+    node plus a stripe partition, so the measured round pays gossip
+    across components, lost-delivery bookkeeping and a finality stall,
+    not a quiet steady state. The world is built and warmed OUTSIDE
+    the timed window (genesis + first blocks are one-time costs); the
+    metric is the marginal cost of a round, the quantity that decides
+    how many virtual rounds a CI scenario sweep can afford. Virtual
+    time advanced and events fired ride along as extras — events/s is
+    the sim's honest throughput number."""
+    from cess_tpu.sim import World
+
+    world = World(seed=b"bench-sim", n_nodes=n_nodes,
+                  topology="random-degree", loss=0.02)
+    world.run_rounds(rounds_warm)          # warm: caches, first finality
+    world.crash(n_nodes - 1)               # churn...
+    world.stripe_partition(2)              # ...and partition, then drain
+    fired0 = len(world.queue.fired_log())
+    virt0 = world.clock.now()
+    t0 = time.perf_counter()
+    world.run_round()
+    wall = time.perf_counter() - t0
+    events = len(world.queue.fired_log()) - fired0
+    virtual_s = world.clock.now() - virt0
+    return wall, {
+        "n_nodes": n_nodes,
+        "events": events,
+        "events_per_s": round(events / wall, 1) if wall > 0 else 0.0,
+        "virtual_s": round(virtual_s, 3),
+        "slots": world.last_round_slots,
+    }
+
+
 def main() -> None:
     global _ASSERT_FINITE
 
@@ -575,10 +610,10 @@ def main() -> None:
                          "TRACE_<metric>.json (Perfetto-loadable)")
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
-                         "stream,degraded,traceov,adaptive,encode")
+                         "stream,degraded,traceov,adaptive,encode,sim")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "stream",
-             "degraded", "traceov", "adaptive", "encode"}
+             "degraded", "traceov", "adaptive", "encode", "sim"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -772,6 +807,23 @@ def main() -> None:
                     "open (cess_tpu/resilience): batches serve on the "
                     "CPU reference codec; results asserted equal to "
                     "the device path before the number is emitted")
+
+    if "sim" in which:
+        # the sim is host-only python — the CPU-safe shape difference
+        # is just world size (smoke keeps the metric NAME so the gate
+        # exercises the same emission path the full run uses)
+        sim_nodes = 40 if (args.smoke or not on_tpu) else 500
+        wall, extra = bench_sim(sim_nodes)
+        # vs_baseline: against one 6 s block interval — how much
+        # faster than real time the sim drains one block round of a
+        # churned + partitioned world
+        emit("sim_500node_round_drain_s", wall, "s",
+             (BLOCK_MS / 1000.0) / wall, **extra,
+             method="wall seconds to drain one virtual round of the "
+                    "deterministic sim (cess_tpu/sim) with one node "
+                    "crashed and a 2-way stripe partition; world "
+                    "built + warmed outside the timed window; lower "
+                    "is better")
 
     if "encode" in which:
         emit("rs_4p8_encode_GiBps_per_chip", encode_gibps, "GiB/s",

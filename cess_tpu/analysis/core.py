@@ -114,7 +114,7 @@ def register(cls: type) -> type:
 def all_rules() -> dict[str, Rule]:
     """The registry with every rule family imported."""
     from . import (determinism, lock_discipline,  # noqa: F401
-                   span_balance, trace_safety)
+                   sim_determinism, span_balance, trace_safety)
 
     return dict(_RULES)
 

@@ -280,9 +280,13 @@ class _NodeRule(Rule):
         # ResilienceStats counters are touched from batcher AND
         # submitter threads — exactly this family's territory.
         # obs/ joined in ISSUE 5: Tracer ring + Span attrs are shared
-        # between submitter, batcher and scrape threads
+        # between submitter, batcher and scrape threads.
+        # sim/ joined in ISSUE 8: the sim is single-threaded by design,
+        # so any lock it grows must follow the same discipline as the
+        # threaded stack it stands in for
         return "serve" in parts or "node" in parts \
-            or "resilience" in parts or "obs" in parts
+            or "resilience" in parts or "obs" in parts \
+            or "sim" in parts
 
 
 @register

@@ -1,0 +1,82 @@
+"""Determinism analyzers for the simulation harness (cess_tpu/sim).
+
+The sim package's whole contract is bit-identical replay: every run
+of a (seed, scenario) pair must produce the same event order, the
+same finalized prefixes, the same SLO transitions. One stray wall
+clock read or ``random`` draw breaks that silently — the replay tests
+would flake instead of fail. These rules make the contract static:
+
+- sim-wallclock : time.time/monotonic/perf_counter — AND time.sleep,
+                  which is worse than nondeterministic in a sim: it
+                  blocks the host for virtual-time that SimClock
+                  should absorb
+- sim-entropy   : random.* / np.random.* / os.urandom / uuid / secrets
+                  — all entropy must come from SHA-256 streams over
+                  the world seed (the ``_u64`` idiom)
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ParsedModule, Rule, dotted, path_parts, register
+
+_WALLCLOCK = {"time.time", "time.time_ns", "time.monotonic",
+              "time.monotonic_ns", "time.perf_counter",
+              "time.perf_counter_ns", "time.sleep",
+              "datetime.now", "datetime.utcnow",
+              "datetime.datetime.now", "datetime.datetime.utcnow"}
+_ENTROPY = {"os.urandom", "uuid.uuid4", "uuid.uuid1"}
+_ENTROPY_PREFIXES = ("random.", "np.random.", "numpy.random.",
+                     "secrets.")
+
+
+class _SimRule(Rule):
+    def applies(self, path: str) -> bool:
+        return "sim" in path_parts(path)
+
+
+@register
+class SimWallclock(_SimRule):
+    id = "sim-wallclock"
+    description = ("wall-clock read or blocking sleep in the "
+                   "simulation harness")
+    hint = ("use the world's SimClock (now()/sleep()) or schedule an "
+            "EventQueue event — virtual time must be the only time "
+            "the sim observes")
+
+    def check(self, mod: ParsedModule) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            fq = dotted(node)
+            if fq in _WALLCLOCK:
+                out.append(self.finding(
+                    mod, node,
+                    f"`{fq}` reads (or blocks on) the wall clock in "
+                    "the deterministic sim"))
+        return out
+
+
+@register
+class SimEntropy(_SimRule):
+    id = "sim-entropy"
+    description = "OS / library entropy source in the simulation harness"
+    hint = ("derive every draw from a SHA-256 stream over the world "
+            "seed (world.u64/_u64), so the same seed replays the "
+            "same world")
+
+    def check(self, mod: ParsedModule) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            fq = dotted(node)
+            if fq is None:
+                continue
+            if fq in _ENTROPY or fq.startswith(_ENTROPY_PREFIXES):
+                out.append(self.finding(
+                    mod, node,
+                    f"`{fq}` is fresh entropy — a same-seed replay "
+                    "would diverge"))
+        return out

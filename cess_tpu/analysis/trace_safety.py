@@ -117,8 +117,11 @@ class _DeviceRule(Rule):
     def applies(self, path: str) -> bool:
         parts = path_parts(path)
         # obs/ joined in ISSUE 5: the tracing hooks sit beside jitted
-        # hot paths, so the same trace-safety discipline applies there
-        return "ops" in parts or "serve" in parts or "obs" in parts
+        # hot paths, so the same trace-safety discipline applies there.
+        # sim/ joined in ISSUE 8: scenario rounds run armed-tracer
+        # spans around the same runtime paths the live stack jits
+        return "ops" in parts or "serve" in parts or "obs" in parts \
+            or "sim" in parts
 
 
 @register

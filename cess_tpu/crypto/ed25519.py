@@ -8,6 +8,7 @@ plenty for control-plane signing rates; the data plane never signs.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 
 P = 2**255 - 19
@@ -104,6 +105,24 @@ class SigningKey:
 
 
 def verify(public: bytes, message: bytes, signature: bytes) -> bool:
+    """Memoized: verification is a pure function of its byte inputs,
+    and gossip delivers the identical (vote, signature) to every node
+    on the network — at cluster-simulation scale (cess_tpu/sim) the
+    same triple is re-checked hundreds of times. The bounded cache
+    dedupes those without changing any verdict."""
+    try:
+        return _verify_cached(public, message, signature)
+    except TypeError:           # unhashable input (e.g. bytearray)
+        return _verify(public, message, signature)
+
+
+@functools.lru_cache(maxsize=65536)
+def _verify_cached(public: bytes, message: bytes,
+                   signature: bytes) -> bool:
+    return _verify(public, message, signature)
+
+
+def _verify(public: bytes, message: bytes, signature: bytes) -> bool:
     if len(signature) != 64 or len(public) != 32:
         return False
     try:

@@ -23,6 +23,7 @@ the same output — re-rolling the lottery is impossible by construction
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 
 from .. import codec
@@ -106,6 +107,23 @@ def vrf_sign(key: ed25519.SigningKey, data: bytes) -> VrfProof:
 
 
 def vrf_verify(public: bytes, data: bytes, proof: VrfProof) -> bool:
+    """Memoized like :func:`ed25519.verify`: a slot claim's proof is a
+    pure function of its inputs and every node on the network verifies
+    the identical claim — the bounded cache collapses those re-checks
+    at simulation scale (cess_tpu/sim) without changing any verdict."""
+    try:
+        return _vrf_verify_cached(public, data, proof)
+    except TypeError:           # unhashable input shapes: verify raw
+        return _vrf_verify(public, data, proof)
+
+
+@functools.lru_cache(maxsize=16384)
+def _vrf_verify_cached(public: bytes, data: bytes,
+                       proof: VrfProof) -> bool:
+    return _vrf_verify(public, data, proof)
+
+
+def _vrf_verify(public: bytes, data: bytes, proof: VrfProof) -> bool:
     if not (isinstance(proof, VrfProof) and isinstance(proof.gamma, bytes)
             and isinstance(proof.c, bytes) and len(proof.c) == 16
             and isinstance(proof.s, bytes) and len(proof.s) == 32

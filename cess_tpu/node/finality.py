@@ -100,7 +100,17 @@ class FinalityGadget:
         branch regains canonicity, a quorum on it becomes provably
         impossible (healing re-gossip supplies the contrary votes), or
         the LOCK_HORIZON liveness backstop passes."""
+        return bool(self.locked_rounds(account, head_number))
+
+    def locked_rounds(self, account: str,
+                      head_number: int) -> tuple[int, ...]:
+        """The rounds whose own-votes currently lock ``account`` (see
+        :meth:`_locked` for the lock rationale). Exposed so external
+        auditors — the sim invariant checkers (cess_tpu/sim) — can
+        assert no lock ever names a round older than LOCK_HORIZON,
+        instead of re-deriving the lock rule from ``_first``."""
         node = self.node
+        rounds = []
         for rnd, votes in self._first.items():
             v = votes.get(account)
             if v is None or rnd <= node.finalized:
@@ -110,8 +120,8 @@ class FinalityGadget:
             if head_number - rnd > self.LOCK_HORIZON:
                 continue
             if not self._quorum_impossible(rnd, v.target_hash):
-                return True
-        return False
+                rounds.append(rnd)
+        return tuple(sorted(rounds))
 
     def vote_jobs(self) -> list[tuple]:
         """Collect the (account, key, round, target_hash) tuples this

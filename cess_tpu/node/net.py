@@ -408,7 +408,11 @@ class NodeService:
                 sock.settimeout(None)
             except OSError:
                 fails += 1
-                time.sleep(0.05)
+                # same schedulable wait seam as _discovery_loop: a
+                # stop() wakes the backoff immediately instead of
+                # draining a bare sleep
+                if self._stop.wait(0.05):
+                    return
                 continue
             conn = _Conn(sock)
             self.conns.append(conn)
@@ -422,7 +426,8 @@ class NodeService:
             if conn in self.conns:
                 self.conns.remove(conn)
             fails = 0 if conn.rx else fails + 1
-            time.sleep(0.05)
+            if self._stop.wait(0.05):
+                return
 
     def _recv_loop(self, conn: _Conn) -> None:
         while not self._stop.is_set() and conn.alive:

@@ -571,6 +571,27 @@ class Node:
             self._undo.pop(self.chain[n].hash(), None)
 
 
+def author_race(candidates: "list[tuple[Node, Block]]"):
+    """Rank an authoring race: primary claims beat secondary, lowest
+    VRF output breaks ties. Returns ``(winner_node, winner_block,
+    losers)`` with losers as ``(node, block)`` pairs in rank order, or
+    ``(None, None, ())`` for an empty race. Shared by the in-process
+    :class:`Network` driver and the discrete-event simulation
+    (cess_tpu/sim) so both worlds apply the identical fork-choice at
+    the authoring seam."""
+    ranked = []
+    for node, blk in candidates:
+        claim = blk.header.claim
+        prio = 0 if claim.vrf is not None else 1
+        tiebreak = claim.vrf.output if claim.vrf else b"\xff" * 32
+        ranked.append((prio, tiebreak, node, blk))
+    if not ranked:
+        return None, None, ()
+    ranked.sort(key=lambda c: (c[0], c[1]))
+    _, _, winner, best = ranked[0]
+    return winner, best, tuple((n, b) for _, _, n, b in ranked[1:])
+
+
 class Network:
     """Drives slots across nodes: fork choice (primary beats secondary,
     lowest VRF output wins ties), broadcast, vote-based finality."""
@@ -602,19 +623,15 @@ class Network:
         lowest VRF output; losers roll back and re-import the winner."""
         self._queue_heartbeats()
         txs = tuple(self.nodes[0].tx_pool)   # one gossip snapshot for all
-        candidates: list[tuple[int, bytes, Node, Block]] = []
+        candidates: list[tuple[Node, Block]] = []
         for node in self.nodes:
             blk = node.try_author(slot, extrinsics=txs)
             if blk is not None:
-                claim = blk.header.claim
-                prio = 0 if claim.vrf is not None else 1
-                tiebreak = claim.vrf.output if claim.vrf else b"\xff" * 32
-                candidates.append((prio, tiebreak, node, blk))
-        if not candidates:
+                candidates.append((node, blk))
+        author_node, best, losers = author_race(candidates)
+        if author_node is None:
             return None
-        candidates.sort(key=lambda c: (c[0], c[1]))
-        _, _, author_node, best = candidates[0]
-        for _, _, loser, _ in candidates[1:]:
+        for loser, _ in losers:
             loser.abort_proposal(requeue=False)
         # drop included txs from the shared pool BEFORE _post_block
         # fires the offchain agents: their new submissions compute

@@ -181,7 +181,8 @@ def slow_filler_bytes(secret: bytes, index: int, size: int,
 
 class MinerAgent:
     def __init__(self, node: Node, account: str, gateways: list[OssGateway],
-                 pipeline: StoragePipeline, engine=None, retry=None):
+                 pipeline: StoragePipeline, engine=None, retry=None,
+                 clock=None):
         self.node = node
         self.account = account
         self.gateways = gateways
@@ -191,6 +192,10 @@ class MinerAgent:
         # fault seam) re-attempt with deterministic backoff instead of
         # waiting a whole deal-servicing round. None = one attempt.
         self.retry = retry
+        # retry backoff clock: any object with sleep(seconds). None =
+        # wall clock; a sim world injects its SimClock so transfer
+        # backoff advances virtual time (cess_tpu/sim).
+        self.clock = clock
         # optional submission engine (cess_tpu/serve): proving and RS
         # repair go through its prove/repair queues — concurrent miners
         # answering the same round coalesce into shared device batches.
@@ -272,8 +277,8 @@ class MinerAgent:
             if attempt > 1:
                 # deterministic jitter keyed by the fragment identity:
                 # replayable in chaos tests, decorrelated across frags
-                time.sleep(self.retry.delay_for(attempt - 1,
-                                                token=frag_hash))
+                (self.clock or time).sleep(
+                    self.retry.delay_for(attempt - 1, token=frag_hash))
             if not faults.allow("offchain.fetch"):
                 continue             # transfer dropped: transient
             blob = gw.fragment_store.get(frag_hash)

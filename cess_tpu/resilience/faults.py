@@ -84,17 +84,22 @@ class FaultPlan:
     dict, or derive one from a seed with :meth:`seeded`."""
 
     def __init__(self, schedule: dict[str, dict[int, FaultSpec]],
-                 seed: bytes = b""):
+                 seed: bytes = b"", clock=None):
         self.schedule = {site: dict(specs)
                          for site, specs in schedule.items()}
         self.seed = seed
+        # ``clock`` is any object with a ``sleep(seconds)`` method;
+        # ``None`` means the wall clock (time.sleep), the production
+        # default. A sim world injects its SimClock so delay faults
+        # advance virtual time instead of blocking the test runner.
+        self.clock = clock
         self._mu = threading.Lock()
         self._counts: dict[str, int] = {}
         self._fired: list[tuple[str, int, str]] = []
 
     @classmethod
     def seeded(cls, seed, sites: dict[str, tuple[float, "FaultSpec | str"]],
-               horizon: int = 64) -> "FaultPlan":
+               horizon: int = 64, clock=None) -> "FaultPlan":
         """Derive a schedule from a seed: for each site, each ordinal
         in ``[0, horizon)`` fires with the given rate, decided by a
         SHA-256 counter stream over (seed, site, ordinal). Same seed
@@ -117,7 +122,7 @@ class FaultPlan:
                 if int.from_bytes(h[:8], "little") < rate * 2 ** 64:
                     ordinals[i] = spec
             schedule[site] = ordinals
-        return cls(schedule, seed=seed_b)
+        return cls(schedule, seed=seed_b, clock=clock)
 
     # -- plan state ---------------------------------------------------------
     def _next(self, site: str) -> tuple[int, FaultSpec | None]:
@@ -196,8 +201,10 @@ def _fire(site: str) -> FaultSpec | None:
     # chaos runs under an armed tracer show WHERE each injected fault
     # landed in the request's path; a no-op without a current span
     _trace.event("fault", site=site, ordinal=n, kind=spec.kind)
-    if spec.delay_s:            # sleep OUTSIDE the plan lock
-        time.sleep(spec.delay_s)
+    if spec.delay_s:            # sleep OUTSIDE the plan lock; the
+        # plan's injected clock (if any) absorbs the delay as virtual
+        # time — the wall clock only moves for unclocked plans
+        (plan.clock or time).sleep(spec.delay_s)
     if spec.kind == "raise":
         detail = f": {spec.message}" if spec.message else ""
         raise FaultInjected(f"injected fault at {site}#{n}{detail}")

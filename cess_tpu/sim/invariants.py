@@ -1,0 +1,216 @@
+"""Invariant checkers: what must hold in EVERY virtual round.
+
+Each checker takes a :class:`~cess_tpu.sim.world.World` and returns a
+list of violation strings (empty = invariant holds). They read the
+same internals the live tests pin — ``Node.finalized``,
+``FinalityGadget.locked_rounds``, the on-chain event log, agent
+fragment stores — so a regression in the production stack surfaces
+here as a named invariant breaking inside a replayable world, not as
+a flaky thread test.
+
+The four core invariants (ISSUE 8):
+
+- ``finalized-prefix``: all honest alive nodes agree on one finalized
+  prefix (no two conflicting finalized blocks anywhere);
+- ``vote-locks``: no own-vote lock (the GRANDPA-style safety lock) is
+  held past the LOCK_HORIZON liveness backstop;
+- ``audit-soundness``: a miner holding corrupt service bytes never
+  passes a service audit (corrupt fragment => challenge failure);
+- ``storage-convergence``: once a file is active, every honest alive
+  assigned miner holds bytes matching the on-chain fragment hash.
+
+Plus two supporting checks scenarios opt into: ``heads-converged``
+(post-heal: one head, one state root) and ``restoral-single-winner``
+(the restoral market pays exactly one rescuer per broken fragment).
+"""
+from __future__ import annotations
+
+from ..crypto.hashing import fragment_hash
+
+
+class InvariantViolation(AssertionError):
+    """Raised when a per-round invariant fails; the message carries
+    every violation string so the seed + round fully localize it."""
+
+
+def check_finalized_prefix(world) -> list[str]:
+    views = []
+    for i, node in enumerate(world.nodes):
+        if not world.alive[i]:
+            continue
+        views.append((node.finalized, i, node))
+    if not views:
+        return []
+    _, ref_i, ref = max(views)
+    out = []
+    for f, i, node in views:
+        # ref's chain covers height f (ref.finalized >= f), and two
+        # finalized prefixes may never disagree at any common height
+        if node.chain[f].hash() != ref.chain[f].hash():
+            out.append(
+                f"finalized-prefix: node {i} finalized "
+                f"#{f}={node.chain[f].hash().hex()[:12]} but node "
+                f"{ref_i} has {ref.chain[f].hash().hex()[:12]} there")
+    return out
+
+
+def check_vote_locks(world) -> list[str]:
+    out = []
+    for i in world.validator_indices():
+        if not world.alive[i]:
+            continue
+        node = world.nodes[i]
+        head = node.chain[-1].number
+        gadget = node.finality
+        for account in node.keystore:
+            for rnd in gadget.locked_rounds(account, head):
+                if head - rnd > gadget.LOCK_HORIZON:
+                    out.append(
+                        f"vote-locks: node {i} account {account} still "
+                        f"locked by round {rnd} at head #{head} "
+                        f"(horizon {gadget.LOCK_HORIZON})")
+    return out
+
+
+def _ref_runtime(world):
+    alive = [i for i in range(world.n) if world.alive[i]]
+    if not alive:
+        return None
+    ref = max(alive, key=lambda i: (world.nodes[i].finalized, -i))
+    return world.nodes[ref].runtime
+
+
+def check_audit_soundness(world) -> list[str]:
+    storage = getattr(world, "storage", None)
+    if storage is None:
+        return []
+    rt = _ref_runtime(world)
+    if rt is None:
+        return []
+    adversarial = {f"m{j}" for j in storage.adversarial_miners}
+    latest: dict[str, dict] = {}
+    for e in rt.state.events_of("audit", "VerifyResult"):
+        d = dict(e.data)
+        latest[d["miner"]] = d
+    out = []
+    for acct, d in latest.items():
+        if acct not in adversarial:
+            continue
+        agent = world.agents.get(acct)
+        if agent is None:
+            continue
+        corrupt_now = any(fragment_hash(blob) != h
+                          for h, blob in agent.store.items())
+        if corrupt_now and d["service"]:
+            out.append(
+                f"audit-soundness: adversarial miner {acct} holds "
+                f"corrupt service bytes but its latest verify verdict "
+                f"passed the service audit")
+    return out
+
+
+def check_storage_convergence(world) -> list[str]:
+    storage = getattr(world, "storage", None)
+    if storage is None:
+        return []
+    rt = _ref_runtime(world)
+    if rt is None:
+        return []
+    adversarial = {f"m{j}" for j in storage.adversarial_miners}
+    homes = getattr(world, "role_homes", {})
+    # fragment -> current on-chain owner. The file's row->miner tuple
+    # is NOT authoritative after a restoral: completion moves single
+    # fragments in frag_of_miner, and the row only flips once the
+    # origin holds none of that row's fragments
+    owner = {frag: acct for (acct, frag), _entry
+             in rt.state.iter_prefix("file_bank", "frag_of_miner")}
+    out = []
+    for (fh,), f in rt.state.iter_prefix("file_bank", "file"):
+        if f.state != "active":
+            continue
+        for seg in f.segments:
+            for h in seg.fragment_hashes:
+                acct = owner.get(h)
+                if acct is None or acct in adversarial:
+                    continue          # corruption is audit's job
+                if rt.file_bank.restoral_order(h) is not None:
+                    continue          # loss reported; repair in flight
+                agent = world.agents.get(acct)
+                home = homes.get(acct)
+                if agent is None or home is None \
+                        or not world.alive[home]:
+                    continue
+                blob = agent.store.get(h)
+                if blob is None:
+                    # only ACTIVE files count: active means every
+                    # assigned miner reported its transfer, so a hole
+                    # with no restoral order is real divergence
+                    out.append(
+                        f"storage-convergence: miner {acct} lost "
+                        f"fragment {h.hex()[:12]} of active file "
+                        f"{fh.hex()[:12]} with no restoral order open")
+                elif fragment_hash(blob) != h:
+                    out.append(
+                        f"storage-convergence: miner {acct} holds "
+                        f"corrupt bytes for fragment {h.hex()[:12]} "
+                        f"of active file {fh.hex()[:12]}")
+    return out
+
+
+def check_heads_converged(world) -> list[str]:
+    heads = {}
+    roots = set()
+    for i, node in enumerate(world.nodes):
+        if not world.alive[i]:
+            continue
+        heads.setdefault(node.chain[-1].hash(), []).append(i)
+        roots.add(node.runtime.state.state_root())
+    if len(heads) > 1:
+        parts = "; ".join(
+            f"{h.hex()[:12]}:{nodes}" for h, nodes in sorted(
+                heads.items(), key=lambda kv: kv[1]))
+        return [f"heads-converged: {len(heads)} distinct heads ({parts})"]
+    if len(roots) > 1:
+        return [f"heads-converged: one head but {len(roots)} state roots"]
+    return []
+
+
+def check_restoral_single_winner(world) -> list[str]:
+    rt = _ref_runtime(world)
+    if rt is None or getattr(world, "storage", None) is None:
+        return []
+    winners: dict[bytes, set[str]] = {}
+    for e in rt.state.events_of("file_bank", "RestoralComplete"):
+        d = dict(e.data)
+        winners.setdefault(d["fragment_hash"], set()).add(d["miner"])
+    out = []
+    for frag, miners in winners.items():
+        if len(miners) > 1:
+            out.append(
+                f"restoral-single-winner: fragment {frag.hex()[:12]} "
+                f"paid {sorted(miners)} — the market must pay exactly "
+                f"one rescuer")
+    return out
+
+
+CHECKERS = {
+    "finalized-prefix": check_finalized_prefix,
+    "vote-locks": check_vote_locks,
+    "audit-soundness": check_audit_soundness,
+    "storage-convergence": check_storage_convergence,
+    "heads-converged": check_heads_converged,
+    "restoral-single-winner": check_restoral_single_winner,
+}
+
+
+def run_checks(world, names, *, context: str = "",
+               strict: bool = True) -> list[str]:
+    """Run the named checkers; raise :class:`InvariantViolation` with
+    every violation (or return them when ``strict=False``)."""
+    violations = []
+    for name in names:
+        violations.extend(f"[{context}] {v}" if context else v
+                          for v in CHECKERS[name](world))
+    if violations and strict:
+        raise InvariantViolation("\n".join(violations))
+    return violations

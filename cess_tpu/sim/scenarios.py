@@ -1,0 +1,348 @@
+"""The scenario library: chaos campaigns as DATA, not code.
+
+A :class:`Scenario` is a frozen description — world shape, a timeline
+of ``(round, action, *args)`` rows, seeded fault sites, SLO targets
+and the invariant checkers to run every virtual round. The
+interpreter (:func:`run_scenario`) is the only code; adding a
+scenario means adding a row to :data:`SCENARIOS`, and the replay
+tests automatically cover it (every scenario must produce
+bit-identical witnesses for two same-seed runs).
+
+Timeline actions refer to nodes by ROLE, not index — ``"miner:1"``
+resolves to miner m1's seed-drawn home node, ``"validator:2"`` to
+node 2, ``"tail:0"`` to the last node (the dormant-spare convention
+for join actions) — so one scenario runs unchanged at 40, 100 or
+1000 nodes.
+
+The witness (:meth:`SimReport.witness`) bundles the event queue's
+fired log, every alive node's finalized prefix, the SLO board's
+transition log and the fault plan's fired log: four independent
+deterministic streams that must ALL match across same-seed replays.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+
+from ..obs import trace
+from ..obs.slo import SloBoard, SloTarget
+from ..resilience import faults as _faults
+from .invariants import run_checks
+from .world import StorageProfile, World
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One chaos campaign, fully declarative.
+
+    - ``world``: ``(key, value)`` pairs of :class:`World` kwargs; the
+      ``"storage"`` value is itself ``(key, value)`` pairs for
+      :class:`StorageProfile`; ``"dormant_tail"`` reserves that many
+      trailing nodes as offline spares for ``join`` actions.
+    - ``timeline``: ``(round, action, *args)`` rows, applied at the
+      START of their round, in order.
+    - ``faults``: ``(site, rate, kind)`` rows armed as one seeded
+      :class:`~cess_tpu.resilience.faults.FaultPlan` on the world's
+      virtual clock.
+    - ``slo``: ``(cls, p99_s)`` targets for the round's board.
+    - ``checks`` run after EVERY round; ``final_checks`` once at the
+      end (convergence properties that only hold after healing).
+    """
+
+    name: str
+    rounds: int
+    world: tuple = ()
+    timeline: tuple = ()
+    faults: tuple = ()
+    slo: tuple = (("round", 4.0), ("upload", 4.0))
+    checks: tuple = ("finalized-prefix", "vote-locks")
+    final_checks: tuple = ()
+
+
+def resolve_ref(world: World, ref: str) -> int:
+    """``"role:ordinal"`` -> node index (see module doc)."""
+    kind, _, tail = ref.partition(":")
+    k = int(tail)
+    if kind in ("node", "validator"):
+        return k
+    if kind == "tail":
+        return world.n - 1 - k
+    if kind == "spare":
+        # k-th plain node: not a validator, not a role home — safe to
+        # churn without silently taking a miner/gateway/TEE down
+        homes = set(getattr(world, "role_homes", {}).values())
+        spares = [i for i in range(world.n_validators, world.n)
+                  if i not in homes]
+        return spares[k]
+    name = {"miner": f"m{k}", "gateway": f"gw{k}", "tee": "tee0"}[kind]
+    return world.role_homes[name]
+
+
+def _seeded_blob(seed: bytes, label: str, size: int) -> bytes:
+    """Deterministic file contents from a SHA-256 stream."""
+    out = bytearray()
+    n = 0
+    while len(out) < size:
+        out += hashlib.sha256(b"cess-sim-blob:" + seed + b"|"
+                              + label.encode() + b"|"
+                              + n.to_bytes(4, "little")).digest()
+        n += 1
+    return bytes(out[:size])
+
+
+@dataclasses.dataclass
+class _Upload:
+    round: int
+    owner: str
+    gw: object
+    calc_sent: bool = False
+
+
+@dataclasses.dataclass
+class SimReport:
+    """What a scenario run leaves behind: the world (for further
+    inspection) and the four witness streams."""
+
+    scenario: str
+    seed: bytes
+    world: World
+    board: SloBoard
+    plan: "_faults.FaultPlan | None"
+    rounds_run: int
+    uploads_active: int
+
+    def witness(self) -> tuple:
+        """Everything that must be bit-identical across two same-seed
+        runs of the same scenario."""
+        return (self.world.queue.fired_log(),
+                self.world.finalized_prefix(),
+                self.board.transition_log(),
+                self.plan.fired_log() if self.plan is not None else ())
+
+
+def _build_world(scenario: Scenario, seed, n_nodes: int | None) -> World:
+    kwargs = dict(scenario.world)
+    storage_pairs = kwargs.pop("storage", None)
+    if storage_pairs is not None:
+        kwargs["storage"] = StorageProfile(**dict(storage_pairs))
+    if n_nodes is not None:
+        kwargs["n_nodes"] = n_nodes
+    n = kwargs.get("n_nodes", 100)
+    tail = kwargs.pop("dormant_tail", 0)
+    if tail:
+        kwargs["dormant"] = tuple(range(n - tail, n))
+    return World(seed, **kwargs)
+
+
+def _drive_uploads(world: World, pending: dict, board: SloBoard,
+                   rnd: int) -> int:
+    """Advance in-flight uploads one lifecycle step per round (the
+    scheduler's calculate_end fires via a root extrinsic, as in the
+    live storage tests) and feed activation latency to the SLO board.
+    Returns how many files went active this round."""
+    active = 0
+    for fh in sorted(pending):
+        rec = pending[fh]
+        f = rec.gw.node.runtime.file_bank.file(fh)
+        if f is None:
+            continue
+        if f.state == "calculate" and not rec.calc_sent:
+            rec.gw.node.submit_extrinsic("root", "file_bank.calculate_end",
+                                         fh)
+            rec.calc_sent = True
+        elif f.state == "active":
+            board.observe("upload", latency_s=float(rnd - rec.round + 1),
+                          tenant=rec.owner)
+            del pending[fh]
+            active += 1
+    return active
+
+
+def _apply_action(world: World, pending: dict, rnd: int,
+                  action: str, args: tuple) -> None:
+    if action in ("crash", "leave", "restart", "join"):
+        getattr(world, action)(resolve_ref(world, args[0]))
+    elif action == "stripe":
+        world.stripe_partition(args[0])
+    elif action == "heal":
+        world.heal()
+    elif action == "upload":
+        gw_ord, owner, size, count = (args + (1,))[:4]
+        gw = world.gateways[gw_ord]
+        for j in range(count):
+            label = f"r{rnd}g{gw_ord}u{j}"
+            data = _seeded_blob(world.seed, label, size)
+            fh = gw.upload(owner, "photos", f"{label}.bin", data)
+            pending[fh] = _Upload(round=rnd, owner=owner, gw=gw)
+    elif action == "drop_fragment":
+        # victim by fragment ROW of the first active file — the row ->
+        # miner mapping is on-chain data, so the scenario stays valid
+        # whatever the deal-assignment draw picked
+        row = args[0]
+        rt = world.gateways[0].node.runtime
+        for (fh,), f in sorted(rt.state.iter_prefix("file_bank", "file")):
+            if f.state != "active":
+                continue
+            agent = world.agents[f.miners[row]]
+            frag = f.segments[0].fragment_hashes[row]
+            if frag not in agent.store:
+                continue
+            del agent.store[frag]
+            agent.tags.pop(frag, None)
+            agent.node.submit_extrinsic(
+                agent.account, "file_bank.generate_restoral_order",
+                fh, frag)
+            world.queue.mark(f"drop_fragment:{agent.account}")
+            return
+        raise LookupError(f"drop_fragment: no active file with a "
+                          f"stored row-{row} fragment")
+    elif action == "repair_contend":
+        # every OTHER miner sees the same open orders and races: all
+        # reconstruct, all claim — the chain pays exactly ONE (the
+        # restoral-single-winner invariant)
+        repaired = 0
+        for rescuer in world.miners:
+            rt = rescuer.node.runtime
+            for (frag,), order in sorted(
+                    rt.state.iter_prefix("file_bank", "restoral")):
+                if order.miner or order.origin_miner == rescuer.account:
+                    continue         # claimed on this view / victim
+                if rescuer.try_repair(frag, world.miners,
+                                      world.gateways):
+                    repaired += 1
+        world.queue.mark(f"repair_contend:{repaired}")
+    else:
+        raise ValueError(f"unknown scenario action {action!r}")
+
+
+def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
+                 tracer=None, strict: bool = True) -> SimReport:
+    """Build the world, arm faults + tracer, interpret the timeline,
+    check invariants every round. Raises
+    :class:`~cess_tpu.sim.invariants.InvariantViolation` on the first
+    round whose checks fail (``strict=False`` collects instead)."""
+    seed_b = seed if isinstance(seed, bytes) else str(seed).encode()
+    world = _build_world(scenario, seed_b, n_nodes)
+    # tiny windows: scenario rounds produce a handful of observations
+    # per class, and the transition log must be able to flip on them
+    board = SloBoard(tuple(SloTarget(cls, p99_s=p99)
+                           for cls, p99 in scenario.slo),
+                     fast_window=4, slow_window=16, eval_every=2)
+    plan = None
+    stack = contextlib.ExitStack()
+    with stack:
+        if scenario.faults:
+            plan = _faults.FaultPlan.seeded(
+                seed_b, {site: (rate, kind)
+                         for site, rate, kind in scenario.faults},
+                horizon=256, clock=world.clock)
+            stack.enter_context(_faults.armed(plan))
+        if tracer is not None:
+            stack.enter_context(trace.armed(tracer))
+        pending: dict[bytes, _Upload] = {}
+        active = 0
+        for rnd in range(scenario.rounds):
+            # one scenario round = ONE connected trace: actions,
+            # authoring, gossip, agent reactions and invariant checks
+            # all hang off this root span
+            with trace.span("sim.round", sys="sim",
+                            scenario=scenario.name, round=rnd):
+                for row in scenario.timeline:
+                    if row[0] == rnd:
+                        _apply_action(world, pending, rnd,
+                                      row[1], tuple(row[2:]))
+                world.run_round()
+                active += _drive_uploads(world, pending, board, rnd)
+                board.observe("round",
+                              latency_s=float(world.last_round_slots))
+                run_checks(world, scenario.checks,
+                           context=f"{scenario.name}:round{rnd}",
+                           strict=strict)
+        run_checks(world, scenario.final_checks,
+                   context=f"{scenario.name}:final", strict=strict)
+    return SimReport(scenario=scenario.name, seed=seed_b, world=world,
+                     board=board, plan=plan, rounds_run=scenario.rounds,
+                     uploads_active=active)
+
+
+# -- the library --------------------------------------------------------------
+SCENARIOS: dict[str, Scenario] = {
+    # miners and plain nodes churn while a file upload is in flight;
+    # lossy fragment transfers force the retry policy to earn its keep
+    "miner_churn": Scenario(
+        name="miner_churn", rounds=14,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 4),)),
+               ("dormant_tail", 1)),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000),
+            (3, "crash", "miner:3"),
+            (5, "restart", "miner:3"),
+            (6, "join", "tail:0"),
+            (8, "leave", "spare:0"),
+            (10, "crash", "spare:1"),
+            (12, "restart", "spare:1"),
+        ),
+        faults=(("offchain.fetch", 0.12, "drop"),),
+        checks=("finalized-prefix", "vote-locks"),
+        final_checks=("storage-convergence", "audit-soundness"),
+    ),
+    # the classic split-brain: stripe the world in two (validators
+    # 4/3 — neither side can finalize), let both sides author, heal,
+    # and demand one head + one state root at the end
+    "partition_heal": Scenario(
+        name="partition_heal", rounds=12,
+        world=(("n_validators", 7),),
+        timeline=(
+            (4, "stripe", 2),
+            (9, "heal",),
+        ),
+        checks=("finalized-prefix", "vote-locks"),
+        final_checks=("heads-converged",),
+    ),
+    # miners m1/m2 store corrupted fragment bytes while reporting
+    # clean transfers; the PoDR2 service audit must fail whichever the
+    # deal assigned (the 3-row assignment always includes one of them)
+    "adversarial_audit": Scenario(
+        name="adversarial_audit", rounds=30,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 4),
+                            ("adversarial_miners", (1, 2))))),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000),
+        ),
+        checks=("finalized-prefix", "vote-locks"),
+        final_checks=("audit-soundness",),
+    ),
+    # every tenant piles onto gateway 0 while gateway 1 idles: the
+    # upload SLO breaches and recovers — the transition log is the
+    # scenario's whole point
+    "gateway_hotspot": Scenario(
+        name="gateway_hotspot", rounds=14,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 4), ("n_gateways", 2)))),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000, 2),
+            (3, "upload", 0, "alice", 20_000, 2),
+            (6, "upload", 1, "alice", 20_000),
+        ),
+        slo=(("round", 4.0), ("upload", 2.0)),
+        checks=("finalized-prefix", "vote-locks"),
+        final_checks=("storage-convergence",),
+    ),
+    # a miner loses a fragment; TWO non-assigned rescuers race the
+    # restoral order — both reconstruct, the market pays exactly one
+    "restoral_auction": Scenario(
+        name="restoral_auction", rounds=14,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 5),))),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000),
+            (8, "drop_fragment", 0),
+            (9, "repair_contend"),
+        ),
+        checks=("finalized-prefix", "vote-locks"),
+        final_checks=("restoral-single-winner", "storage-convergence"),
+    ),
+}

@@ -25,3 +25,10 @@ from cess_tpu.parallel import compat  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 compat.set_cpu_device_count(8)
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' (ROADMAP): anything slow-marked
+    # (the 1000-node sim world) is outside the gate
+    config.addinivalue_line(
+        "markers", "slow: outside the tier-1 gate (large worlds)")

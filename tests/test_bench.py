@@ -25,6 +25,7 @@ EXPECTED = (
     "stream_encode_tag_traced_GiBps",
     "degraded_encode_GiBps",
     "adaptive_mixed_p99_ms",
+    "sim_500node_round_drain_s",
     "rs_4p8_encode_GiBps_per_chip",
 )
 
@@ -83,6 +84,12 @@ def test_bench_smoke_every_metric_finite():
     assert ad["value"] < ad["static_p99_ms"]
     assert ad["static_met_target"] is False
     assert ad["static_p99_ms"] > ad["target_ms"]
+    # the sim drain metric (ISSUE 8): one churned+partitioned virtual
+    # round drained in finite wall time, with the sim's throughput
+    # counters riding along
+    sim = got["sim_500node_round_drain_s"]
+    assert sim["events"] >= 1 and sim["events_per_s"] > 0
+    assert sim["virtual_s"] > 0 and sim["n_nodes"] >= 2
 
 
 # -- tools/bench_diff.py: the perf-trajectory regression gate ---------------
@@ -133,6 +140,20 @@ class TestBenchDiff:
         # a metric new this round is reported, never gate-failing
         assert rows["adaptive_mixed_p99_ms"]["note"] == "only in current"
         assert rep["regressions"] == ["rs_4p8_encode_GiBps_per_chip"]
+
+    def test_wallclock_seconds_are_lower_is_better(self):
+        # ISSUE 8 satellite: the sim drain metric ends in _s and must
+        # regress UPWARD — without swallowing _per_s throughput names
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import bench_diff
+        finally:
+            sys.path.pop(0)
+        assert bench_diff.lower_is_better("sim_500node_round_drain_s")
+        assert bench_diff.lower_is_better("fragment_repair_p99_ms")
+        assert not bench_diff.lower_is_better(
+            "podr2_100k_tag_verify_frags_per_s")
+        assert not bench_diff.lower_is_better("stream_encode_tag_GiBps")
 
     def test_default_against_is_the_next_lower_round(self, tmp_path,
                                                       monkeypatch):

@@ -571,6 +571,78 @@ class TestDeterminism:
 
 
 # ---------------------------------------------------------------------------
+# sim determinism (sim/)
+# ---------------------------------------------------------------------------
+DIRTY_SIM = """
+    import random
+    import secrets
+    import time
+
+    import numpy as np
+
+    def run_round(world):
+        start = time.monotonic()
+        time.sleep(0.05)
+        jitter = random.random()
+        noise = np.random.uniform()
+        nonce = secrets.token_bytes(8)
+        return time.time() - start
+"""
+
+CLEAN_SIM = """
+    import hashlib
+
+    def run_round(world):
+        world.clock.sleep(0.05)
+        h = hashlib.sha256(world.seed + b"|round").digest()
+        jitter = int.from_bytes(h[:8], "big") / 2**64
+        return world.clock.now()
+"""
+
+
+class TestSimDeterminism:
+    def test_dirty_fixture_fires_every_rule(self):
+        r = lint(DIRTY_SIM, "cess_tpu/sim/fixture.py")
+        assert rules_at(r) == {"sim-wallclock", "sim-entropy"}
+        wall = [f.message for f in r.findings if f.rule == "sim-wallclock"]
+        # time.sleep is banned too: it blocks the host for virtual
+        # time the SimClock should absorb
+        assert any("time.sleep" in m for m in wall)
+        assert any("time.time" in m for m in wall)
+        assert any("time.monotonic" in m for m in wall)
+        ent = [f.message for f in r.findings if f.rule == "sim-entropy"]
+        assert any("random.random" in m for m in ent)
+        assert any("np.random" in m for m in ent)
+        assert any("secrets." in m for m in ent)
+
+    def test_clean_twin_is_silent(self):
+        r = lint(CLEAN_SIM, "cess_tpu/sim/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_sim_rules_do_not_apply_elsewhere(self):
+        # node/ legitimately sleeps and reads wall clocks
+        assert lint(DIRTY_SIM, "cess_tpu/node/fixture.py").findings == []
+
+    def test_sim_package_is_clean(self):
+        """ISSUE 8 satellite: the whole sim harness scans clean under
+        its own determinism family PLUS trace-safety and
+        lock-discipline, with zero suppressions; baseline stays
+        empty."""
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "sim")], root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        # the borrowed families really apply under sim/ (dirty
+        # fixtures fire there), so the clean scan is meaningful
+        assert "lock-unguarded-write" in rules_at(
+            lint(DIRTY_LOCK, "cess_tpu/sim/fixture.py"))
+        assert "trace-print" in rules_at(
+            lint(DIRTY_TRACE, "cess_tpu/sim/fixture.py"))
+        assert analysis.load_baseline(BASELINE) == {}
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline workflow
 # ---------------------------------------------------------------------------
 class TestSuppression:

@@ -1,0 +1,401 @@
+"""tier-1 gate for the deterministic simulation harness (ISSUE 8).
+
+Four layers of proof:
+
+- the primitives: virtual clock monotonicity, the seeded event
+  queue's tie-breaking (same seed => bit-identical fired log), the
+  topology generators;
+- the world: a seeded sim TWIN of the live partition/heal finality
+  test (tests/test_fork.py keeps the threaded original) that
+  reproduces the identical finalized prefix on two same-seed replays;
+- the scenario library: every scenario replays bit-identically
+  (witness = event log + finalized prefixes + SLO transitions + fired
+  faults), the full library passes at 100 nodes, and the adversarial
+  scenario's audit rounds each form ONE connected trace with the
+  corrupt fragment's challenge failure visible as span attributes;
+- the invariant checkers: expected-violation fixtures prove each
+  tripwire actually fires (a checker that can't fail checks nothing).
+
+The 1000-node world is ``slow``-marked — outside the tier-1 gate.
+"""
+import time
+import types
+
+import pytest
+
+from cess_tpu.obs import trace
+from cess_tpu.resilience import faults
+from cess_tpu.sim import (SCENARIOS, US, EventQueue, InvariantViolation,
+                          SimClock, World, run_checks, run_scenario,
+                          topology_edges)
+
+
+# ---------------------------------------------------------------------------
+# virtual clock + seeded event queue
+# ---------------------------------------------------------------------------
+class TestSimClock:
+    def test_monotonic_advance(self):
+        c = SimClock()
+        c.advance_to_us(5 * US)
+        assert c.now_us() == 5 * US and c.now() == 5.0
+        with pytest.raises(ValueError):
+            c.advance_to_us(4 * US)
+
+    def test_sleep_advances_virtual_time_not_wall_time(self):
+        c = SimClock()
+        t0 = time.perf_counter()
+        c.sleep(3600.0)            # an hour of virtual time
+        assert time.perf_counter() - t0 < 0.1
+        assert c.now() == 3600.0
+        with pytest.raises(ValueError):
+            c.sleep(-1.0)
+
+    def test_wait_consumes_timeout_and_returns_false(self):
+        c = SimClock(start_us=10)
+        assert c.wait(0.5) is False
+        assert c.now_us() == 10 + US // 2
+
+    def test_deadline(self):
+        c = SimClock()
+        c.sleep(1.0)
+        assert c.deadline(2.5) == 3.5
+
+
+class TestEventQueue:
+    def test_fires_in_time_order_and_logs(self):
+        q = EventQueue(b"s")
+        hits = []
+        q.push(0.2, "b", lambda: hits.append("b"))
+        q.push(0.1, "a", lambda: hits.append("a"))
+        q.mark("setup")
+        assert q.drain() == 2
+        assert hits == ["a", "b"]
+        assert q.fired_log() == ((0, "setup"), (US // 10, "a"),
+                                 (US // 5, "b"))
+
+    def test_same_time_ties_broken_by_seed_not_insertion(self):
+        def order(seed, names):
+            q = EventQueue(seed)
+            hits = []
+            for n in names:
+                q.push(0.1, n, lambda n=n: hits.append(n))
+            q.drain()
+            return hits
+
+        names = [f"e{i}" for i in range(12)]
+        a = order(b"seed-A", names)
+        # same seed, same pushes => identical order, every run
+        assert order(b"seed-A", names) == a
+        # a different seed shuffles the same-time ties
+        assert order(b"seed-B", names) != a
+
+    def test_cannot_schedule_in_the_past(self):
+        q = EventQueue(b"s")
+        q.clock.advance_to_us(100)
+        with pytest.raises(ValueError):
+            q.push_at_us(50, "late", lambda: None)
+
+    def test_run_until_fires_strictly_before_and_advances(self):
+        q = EventQueue(b"s")
+        hits = []
+        q.push_at_us(10, "in", lambda: hits.append("in"))
+        q.push_at_us(20, "at", lambda: hits.append("at"))
+        assert q.run_until_us(20) == 1
+        assert hits == ["in"] and q.clock.now_us() == 20 and len(q) == 1
+
+    def test_drain_guards_against_runaway_self_scheduling(self):
+        q = EventQueue(b"s")
+
+        def reschedule():
+            q.push(0.001, "again", reschedule)
+
+        q.push(0.001, "again", reschedule)
+        with pytest.raises(RuntimeError):
+            q.drain(max_events=100)
+
+
+# ---------------------------------------------------------------------------
+# topology generators
+# ---------------------------------------------------------------------------
+def _connected(n, edges):
+    adj = {i: set() for i in range(n)}
+    for a, b in edges:
+        adj[a].add(b)
+        adj[b].add(a)
+    seen, todo = {0}, [0]
+    while todo:
+        for j in adj[todo.pop()]:
+            if j not in seen:
+                seen.add(j)
+                todo.append(j)
+    return len(seen) == n
+
+
+class TestTopology:
+    @pytest.mark.parametrize("kind", ["chain", "ring", "random-degree",
+                                      "clustered"])
+    def test_connected_and_deterministic(self, kind):
+        edges = topology_edges(kind, 30, b"topo")
+        assert _connected(30, edges)
+        assert topology_edges(kind, 30, b"topo") == edges
+        assert all(a < b for a, b in edges)     # canonical orientation
+
+    def test_chain_and_ring_shapes(self):
+        assert len(topology_edges("chain", 10, b"t")) == 9
+        assert len(topology_edges("ring", 10, b"t")) == 10
+
+    def test_random_degree_is_seed_sensitive(self):
+        assert topology_edges("random-degree", 30, b"a") != \
+            topology_edges("random-degree", 30, b"b")
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError):
+            topology_edges("torus", 10, b"t")
+
+
+# ---------------------------------------------------------------------------
+# the sim twin of tests/test_fork.py::test_partition_diverges_then_converges
+# (satellite: the live threaded original stays; this is the seeded twin)
+# ---------------------------------------------------------------------------
+def _partition_twin(seed):
+    """The live test's phases on a seeded 5-node world: finalize,
+    split 2-vs-3 (neither side reaches 2/3 of 5), diverge, heal,
+    converge, finality resumes. Returns (world, fin0)."""
+    world = World(seed, n_nodes=5, n_validators=5, topology="ring",
+                  loss=0.0)
+    world.run_rounds(3)
+    fin0 = world.nodes[0].finalized
+    assert fin0 > 0, "full validator set must finalize live"
+
+    world.set_partition([[0, 1], [2, 3, 4]])
+    world.run_rounds(3)
+    head_a = world.nodes[0].chain[-1]
+    head_b = world.nodes[2].chain[-1]
+    assert head_a.hash() != head_b.hash(), "both sides must author"
+    assert all(n.finalized == fin0 for n in world.nodes), \
+        "a minority partition must not finalize"
+
+    world.heal()
+    run_checks(world, ("heads-converged", "finalized-prefix"))
+    assert world.nodes[0].chain[-1].number >= head_b.number
+
+    world.run_rounds(2)
+    assert world.nodes[0].finalized > fin0, \
+        "finality must resume past the partition"
+    return world, fin0
+
+
+def test_partition_twin_diverges_then_converges():
+    _partition_twin(b"fork-twin")
+
+
+def test_partition_twin_replays_identical_finalized_prefix():
+    a, _ = _partition_twin(b"fork-twin")
+    b, _ = _partition_twin(b"fork-twin")
+    assert a.finalized_prefix() == b.finalized_prefix()
+    assert a.queue.fired_log() == b.queue.fired_log()
+    # and a different seed is a different world (the witness moves)
+    c, _ = _partition_twin(b"fork-twin-2")
+    assert c.queue.fired_log() != a.queue.fired_log()
+
+
+# ---------------------------------------------------------------------------
+# the scenario library
+# ---------------------------------------------------------------------------
+def _assert_scenario_behavior(name, report):
+    """The per-scenario property that makes the run meaningful, pinned
+    on top of the in-run invariant checks."""
+    rt = report.world.nodes[0].runtime
+    if name == "gateway_hotspot":
+        # the hotspot's whole point: the upload SLO class breached and
+        # the transition log (the replay witness) recorded it
+        assert any(cls == "upload" and to != "ok"
+                   for cls, _frm, to, _n in report.board.transition_log())
+    elif name == "adversarial_audit":
+        adversarial = {f"m{j}"
+                       for j in report.world.storage.adversarial_miners}
+        verdicts = {}
+        for e in rt.state.events_of("audit", "VerifyResult"):
+            d = dict(e.data)
+            verdicts[d["miner"]] = d
+        judged = [d for m, d in verdicts.items() if m in adversarial]
+        assert judged, "no adversarial miner was ever audited"
+        assert all(not d["service"] for d in judged), \
+            "a corrupt fragment passed its service audit"
+    elif name == "restoral_auction":
+        done = [dict(e.data)
+                for e in rt.state.events_of("file_bank",
+                                            "RestoralComplete")]
+        assert len(done) == 1, "the market must pay exactly one rescuer"
+        marks = [m for _t, m in report.world.queue.fired_log()
+                 if m.startswith("repair_contend:")]
+        assert marks and int(marks[0].split(":")[1]) >= 2, \
+            "contention needs at least two racing reconstructions"
+    elif name == "miner_churn":
+        # whether a 0.12-rate drop ordinal is actually crossed depends
+        # on seed and world size; what matters for replay is that the
+        # lossy-fetch plan is armed with a seeded schedule — its fired
+        # log (possibly empty) is already part of the witness
+        assert report.plan is not None and report.plan.schedule, \
+            "the lossy-fetch fault plan was never armed"
+        assert report.uploads_active >= 1
+    elif name == "partition_heal":
+        assert max(f for f, _ in report.world.finalized_prefix()) > 0
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_replays_bit_identical(name):
+    """ISSUE 8 acceptance: two same-seed runs of every scenario
+    produce bit-identical event logs, finalized prefixes and SLO
+    transition logs (plus fired faults) — and the run exhibits the
+    behavior the scenario exists to exercise."""
+    sc = SCENARIOS[name]
+    a = run_scenario(sc, b"replay", n_nodes=20)
+    b = run_scenario(sc, b"replay", n_nodes=20)
+    assert a.witness() == b.witness()
+    assert a.rounds_run == sc.rounds
+    _assert_scenario_behavior(name, a)
+
+
+def test_full_library_at_100_nodes():
+    """ISSUE 8 acceptance: a 100-node world runs the full scenario
+    library inside tier-1 — every in-run and final invariant check
+    passes at that scale, under bounded wall-clock."""
+    for name in sorted(SCENARIOS):
+        report = run_scenario(SCENARIOS[name], b"ci-100", n_nodes=100)
+        assert report.rounds_run == SCENARIOS[name].rounds
+        assert max(f for f, _ in report.world.finalized_prefix()) > 0, \
+            f"{name}: the 100-node world never finalized"
+
+
+def test_adversarial_scenario_traces_connect():
+    """Armed-tracer integration: each scenario round is ONE connected
+    trace (single trace id, zero orphaned parents), and the corrupt
+    fragment's challenge failure is visible as span attributes — the
+    ``offchain.verify`` span carries ``service_ok=False``."""
+    tracer = trace.Tracer(capacity=65536)
+    sc = SCENARIOS["adversarial_audit"]
+    report = run_scenario(sc, b"traced", n_nodes=20, tracer=tracer)
+    assert tracer.dropped == 0, "ring wrapped; the analysis needs all spans"
+    spans = tracer.finished()
+    # single trace id: every span carries the session's
+    assert {s["trace_id"] for s in spans} == {tracer.trace_id}
+    # one tree per round: the ONLY roots are the per-round sim.round
+    # spans, and every other span hangs off a recorded parent — no
+    # orphaned parents, no stray trees
+    roots = [s for s in spans if s["parent_id"] == 0]
+    assert [s["name"] for s in roots] == ["sim.round"] * sc.rounds
+    ids = {s["span_id"] for s in spans}
+    orphans = [s for s in spans
+               if s["parent_id"] != 0 and s["parent_id"] not in ids]
+    assert orphans == [], f"orphaned parents: {orphans[:3]}"
+    verifies = [s for s in spans if s["name"] == "offchain.verify"]
+    assert verifies, "no audit verification was traced"
+    adversarial = {f"m{j}" for j in report.world.storage.adversarial_miners}
+    bad = [s for s in verifies
+           if s["attrs"].get("miner") in adversarial]
+    # the FIRST audit round predates the corrupt upload (nothing but
+    # clean fillers to audit); once the corrupt fragments are stored,
+    # the challenge failure must be visible as span attributes
+    assert any(s["attrs"]["service_ok"] is False for s in bad), \
+        "the corrupt fragment's challenge failure must be span-visible"
+    assert any(s["attrs"].get("service_ok") is True for s in verifies), \
+        "honest miners must still pass their audits"
+
+
+# ---------------------------------------------------------------------------
+# invariant tripwires: each checker provably FIRES on a violation
+# ---------------------------------------------------------------------------
+class TestInvariantTripwires:
+    def test_finalized_prefix_fires_on_conflicting_finalized_block(self):
+        world = World(b"tamper", n_nodes=5, n_validators=4,
+                      topology="ring")
+        world.run_rounds(3)
+        node = world.nodes[1]
+        assert node.finalized >= 1
+        run_checks(world, ("finalized-prefix",))        # holds pre-tamper
+        # tamper: node 1's finalized block is swapped for a DIFFERENT
+        # header (its parent) — two conflicting finalized prefixes
+        node.chain[node.finalized] = node.chain[node.finalized - 1]
+        with pytest.raises(InvariantViolation, match="finalized-prefix"):
+            run_checks(world, ("finalized-prefix",))
+
+    def test_vote_locks_fires_when_horizon_filter_regresses(self):
+        world = World(b"locks", n_nodes=5, n_validators=4,
+                      topology="ring")
+        world.run_rounds(2)
+        run_checks(world, ("vote-locks",))              # holds pre-tamper
+        # locked_rounds() itself enforces the horizon (finality.py
+        # names this checker as its regression tripwire); simulate
+        # that filter regressing on one node
+        node = world.nodes[0]
+        head = node.chain[-1].number
+        horizon = node.finality.LOCK_HORIZON
+        node.finality.locked_rounds = \
+            lambda account, h: [head - horizon - 5]
+        with pytest.raises(InvariantViolation, match="vote-locks"):
+            run_checks(world, ("vote-locks",))
+
+    def test_audit_soundness_fires_on_corrupt_store_with_passing_verdict(
+            self):
+        # a minimal duck-typed world: adversarial miner m1 holds bytes
+        # that do NOT hash to their fragment id, yet the latest
+        # on-chain verdict says its service audit PASSED
+        event = types.SimpleNamespace(
+            data=(("miner", "m1"), ("service", True), ("idle", True)))
+        state = types.SimpleNamespace(
+            events_of=lambda mod, name: [event])
+        node = types.SimpleNamespace(
+            finalized=1, runtime=types.SimpleNamespace(state=state))
+        agent = types.SimpleNamespace(store={b"\x11" * 32: b"corrupt"})
+        world = types.SimpleNamespace(
+            n=1, alive=[True], nodes=[node],
+            storage=types.SimpleNamespace(adversarial_miners=(1,)),
+            agents={"m1": agent})
+        with pytest.raises(InvariantViolation, match="audit-soundness"):
+            run_checks(world, ("audit-soundness",))
+
+    def test_strict_false_collects_instead_of_raising(self):
+        world = World(b"collect", n_nodes=5, n_validators=4,
+                      topology="ring")
+        world.run_rounds(3)
+        node = world.nodes[1]
+        node.chain[node.finalized] = node.chain[node.finalized - 1]
+        out = run_checks(world, ("finalized-prefix",), strict=False,
+                         context="tampered")
+        assert len(out) == 1 and out[0].startswith("[tampered]")
+
+
+# ---------------------------------------------------------------------------
+# satellite: fault-plan delays ride the injected virtual clock
+# ---------------------------------------------------------------------------
+def test_fault_delay_advances_virtual_clock_not_wall_clock():
+    clock = SimClock()
+    plan = faults.FaultPlan(
+        {"sim.site": {0: faults.FaultSpec(kind="delay", delay_s=7.5)}},
+        seed=b"d", clock=clock)
+    with faults.armed(plan):
+        t0 = time.perf_counter()
+        faults.inject("sim.site")
+        assert time.perf_counter() - t0 < 0.1
+    assert clock.now() == 7.5
+    assert plan.fired_log() == (("sim.site", 0, "delay"),)
+
+
+# ---------------------------------------------------------------------------
+# the thousand-node world (outside tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_thousand_node_world_partitions_and_heals():
+    world = World(b"kilo", n_nodes=1000, n_validators=7,
+                  topology="random-degree", loss=0.0)
+    world.run_rounds(2)
+    run_checks(world, ("finalized-prefix", "vote-locks"))
+    fin0 = max(f for f, _ in world.finalized_prefix())
+    assert fin0 > 0
+    world.stripe_partition(2)
+    world.run_rounds(2)
+    world.heal()
+    run_checks(world, ("heads-converged", "finalized-prefix"))
+    world.run_rounds(1)
+    assert max(f for f, _ in world.finalized_prefix()) > fin0

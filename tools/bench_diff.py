@@ -78,7 +78,11 @@ def newest_rounds() -> list[str]:
 
 
 def lower_is_better(metric: str) -> bool:
-    return metric.endswith("_ms")
+    # latencies (_ms) and wall-clock drains (_s) regress UPWARD;
+    # rates (_per_s, _GiBps, _x) regress downward — "_s" must not
+    # swallow throughput names like podr2_..._frags_per_s
+    return metric.endswith("_ms") or (
+        metric.endswith("_s") and not metric.endswith("_per_s"))
 
 
 def diff(prev: dict[str, float], cur: dict[str, float],
