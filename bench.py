@@ -763,16 +763,40 @@ def main() -> None:
         if args.trace:
             with open("TRACE_traceov.json", "w") as f:
                 json.dump(tracer.export_chrome(), f)
+        # the flight-recorder companion (ISSUE 9): same run with the
+        # tracer AND a FlightRecorder attached — every finished span
+        # offered, retention decided at each root. The delta vs the
+        # untraced run is what tail-sampled retention costs the
+        # hottest path when armed (disarmed cost is pinned at zero in
+        # tests/test_flight.py).
+        from cess_tpu.obs import flight as obs_flight
+
+        tracer2 = obs_trace.Tracer(capacity=65536)
+        recorder = obs_flight.FlightRecorder(
+            b"bench-flight", baseline_rate=1 / 16)
+        tracer2.attach_flight(recorder)
+        with obs_trace.armed(tracer2), obs_flight.armed(recorder):
+            v_fl, _ = bench_stream(jnp, jax, stream_batch, stream_n,
+                                   seg)
+        flight_frac = (v_off - v_fl) / v_off
+        if _ASSERT_FINITE:
+            assert np.isfinite(flight_frac), \
+                f"flight_overhead_frac produced {flight_frac!r}"
         emit("stream_encode_tag_traced_GiBps", v_on, "GiB/s",
              v_on / 12.0,
              untraced_GiBps=round(v_off, 3),
              trace_overhead_frac=round(frac, 4),
              spans=len(tracer.finished()),
+             flight_GiBps=round(v_fl, 3),
+             flight_overhead_frac=round(flight_frac, 4),
+             pinned=recorder.snapshot()["pins"],
              method="streamed from-host-bytes run with a request "
                     "tracer armed (cess_tpu/obs); trace_overhead_frac "
                     "= (untraced - traced)/untraced over back-to-back "
                     "runs — noise-level values (incl. slightly "
-                    "negative) mean the hooks are free")
+                    "negative) mean the hooks are free; "
+                    "flight_overhead_frac adds tail-sampled retention "
+                    "(obs/flight.py) on top of the armed tracer")
 
     if "adaptive" in which:
         # sustained mixed encode+verify at a fixed verify p99 target,

@@ -13,6 +13,12 @@ would flake instead of fail. These rules make the contract static:
 - sim-entropy   : random.* / np.random.* / os.urandom / uuid / secrets
                   — all entropy must come from SHA-256 streams over
                   the world seed (the ``_u64`` idiom)
+
+The family also covers the flight recorder's retention-decision code
+(obs/flight.py + obs/incident.py, ISSUE 9): "same seed retains the
+same traces and bundles the same incidents" is the identical replay
+contract, so a wall-clock read or entropy draw in a pin decision is
+the same class of bug as one in a sim world.
 """
 from __future__ import annotations
 
@@ -32,7 +38,13 @@ _ENTROPY_PREFIXES = ("random.", "np.random.", "numpy.random.",
 
 class _SimRule(Rule):
     def applies(self, path: str) -> bool:
-        return "sim" in path_parts(path)
+        parts = path_parts(path)
+        if "sim" in parts:
+            return True
+        # the retention layer makes seeded decisions under the same
+        # replay contract as sim worlds
+        return "obs" in parts and parts[-1] in ("flight.py",
+                                                "incident.py")
 
 
 @register

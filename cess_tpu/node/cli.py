@@ -118,6 +118,19 @@ def main(argv=None) -> int:
                          "Chrome trace-event JSON (open it in "
                          "Perfetto or chrome://tracing). Without the "
                          "flag every trace hook is a no-op")
+    ap.add_argument("--flight", nargs="?", const="", default=None,
+                    metavar="DIR",
+                    help="arm the flight recorder (cess_tpu/obs/"
+                         "flight.py) over the --trace tracer: "
+                         "tail-sampled trace retention (anomalous "
+                         "traces pinned past ring eviction plus a "
+                         "seeded baseline), black-box journals, and "
+                         "an IncidentReporter whose bundles are "
+                         "served live via the cess_incidentDump RPC "
+                         "and — with --flight=DIR — written on exit "
+                         "as one JSON file per incident (render with "
+                         "tools/incident_view.py). Requires --trace; "
+                         "absent = zero-cost off")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="TARGETS",
                     help="attach an SLO board (cess_tpu/obs/slo.py) to "
@@ -290,6 +303,10 @@ def main(argv=None) -> int:
     engine = _make_cli_engine(args, spec)
     if engine is not None:
         nodes[0].engine = engine
+    recorder, reporter = _arm_cli_flight(args, tracer, engine)
+    if reporter is not None:
+        nodes[0].flight = recorder
+        nodes[0].incidents = reporter  # cess_incidentDump RPC surface
     rpc = None
     import threading
 
@@ -322,6 +339,7 @@ def main(argv=None) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)
     return 0
 
@@ -352,6 +370,60 @@ def _finish_cli_tracer(args, tracer) -> None:
             json.dump(tracer.export_chrome(), f)
         print(f"trace written to {args.trace} "
               f"({len(tracer.finished())} spans)", file=sys.stderr)
+
+
+def _arm_cli_flight(args, tracer, engine):
+    """--flight: build a FlightRecorder over the --trace tracer
+    (tail-sampled retention + black-box journals) and an
+    IncidentReporter bundling its triggers; returns ``(recorder,
+    reporter)`` (attached by the callers as ``node.flight`` /
+    ``node.incidents`` so cess_incidentDump serves them) or
+    ``(None, None)``. SLO targets on the engine's board become the
+    over-objective pin thresholds."""
+    if getattr(args, "flight", None) is None:
+        return None, None
+    if tracer is None:
+        print("--flight requires --trace (retention decisions run on "
+              "finished spans)", file=sys.stderr)
+        raise SystemExit(2)
+    from ..obs import flight as obs_flight
+    from ..obs.incident import IncidentReporter
+
+    objectives = {}
+    board = None if engine is None else engine.slo
+    if board is not None:
+        objectives = {t.cls: t.p99_s for t in board.targets}
+    recorder = obs_flight.arm(obs_flight.FlightRecorder(
+        b"cess-cli", baseline_rate=1 / 64, objectives=objectives))
+    tracer.attach_flight(recorder)
+    reporter = IncidentReporter(recorder, engine=engine)
+    return recorder, reporter
+
+
+def _finish_cli_flight(args, recorder, reporter) -> None:
+    """Disarm and, when --flight carried a DIR, write each incident
+    bundle as its own JSON artifact (render a timeline with
+    tools/incident_view.py)."""
+    if recorder is None:
+        return
+    import os
+
+    from ..obs import flight as obs_flight
+
+    obs_flight.disarm()
+    bundles = reporter.bundles()
+    if args.flight:
+        os.makedirs(args.flight, exist_ok=True)
+        for b in bundles:
+            path = os.path.join(
+                args.flight, f"incident_{b['seq']:03d}_{b['trigger']}.json")
+            with open(path, "w") as f:
+                json.dump(b, f, indent=2)
+    snap = recorder.snapshot()
+    where = f", written to {args.flight}" if args.flight and bundles else ""
+    print(f"flight recorder: {snap['pins']} pinned trace(s) "
+          f"({snap['pinned_spans']} spans), {len(bundles)} incident "
+          f"bundle(s){where}", file=sys.stderr)
 
 
 def _make_cli_engine(args, spec):
@@ -501,6 +573,10 @@ def _run_tcp_node(args, spec) -> int:
     engine = _make_cli_engine(args, spec)
     if engine is not None:
         node.engine = engine
+    recorder, reporter = _arm_cli_flight(args, tracer, engine)
+    if reporter is not None:
+        node.flight = recorder
+        node.incidents = reporter     # cess_incidentDump RPC surface
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
     rpc = None
@@ -532,6 +608,7 @@ def _run_tcp_node(args, spec) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)
     return 0
 

@@ -26,6 +26,7 @@ import dataclasses
 
 from .. import codec
 from ..chain.offences import Vote, sign_vote
+from ..obs import flight as _flight
 
 
 @codec.register
@@ -58,6 +59,11 @@ class FinalityGadget:
         # ingested — prevents a concurrent collector from double-
         # signing the same round (self-equivocation)
         self._signing: set[tuple[int, str]] = set()
+        # accounts observed locked by vote_jobs' last pass — the edge
+        # detector behind the flight journal's lock-acquire/release
+        # entries (an own-vote lock engaging is exactly the "finality
+        # stall" moment a postmortem needs on its timeline)
+        self._lock_active: set[str] = set()
         self.equivocations: list[tuple[Vote, Vote]] = []
         self.justifications: dict[int, Justification] = {}
 
@@ -143,9 +149,25 @@ class FinalityGadget:
         if head.number <= node.finalized:
             return jobs
         lo = max(node.finalized + 1, head.number - self.VOTE_TAIL + 1)
-        voters = [(a, k) for a, k in node.keystore.items()
-                  if a in node.authorities
-                  and not self._locked(a, head.number)]
+        voters = []
+        locked_now = set()
+        for a, k in node.keystore.items():
+            if a not in node.authorities:
+                continue
+            if self._locked(a, head.number):
+                locked_now.add(a)
+            else:
+                voters.append((a, k))
+        # journal lock EDGES (under the node lock the caller holds —
+        # safe: finality entries never trigger bundle builds)
+        if locked_now != self._lock_active:
+            for a in sorted(locked_now - self._lock_active):
+                _flight.note("finality", "lock-acquire", account=a,
+                             head=head.number)
+            for a in sorted(self._lock_active - locked_now):
+                _flight.note("finality", "lock-release", account=a,
+                             head=head.number)
+            self._lock_active = locked_now
         for rnd in range(lo, head.number + 1):
             target = node.chain[rnd]
             for account, key in voters:

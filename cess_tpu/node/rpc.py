@@ -21,11 +21,18 @@ Methods:
   (header-inclusion proofs; pallet-mmr role)
   cess_minerInfo [account], cess_fileInfo [hex hash], cess_challenge
   cess_engineStats   (submission-engine queue/batch/latency counters)
-  cess_traceDump     (Chrome trace-event JSON dump of the armed
-                      request tracer, Perfetto-loadable; cess_tpu/obs)
+  cess_traceDump [trace_id?, limit?]
+                     (Chrome trace-event JSON dump of the armed
+                      request tracer, Perfetto-loadable, optionally
+                      scoped to one trace / the newest N spans;
+                      cess_tpu/obs)
   cess_sloStatus     (SLO board snapshot: per-class burn rates/states/
                       transitions, per-tenant accounting, adaptive
                       knobs + admission state; obs/slo.py)
+  cess_incidentDump [limit?]
+                     (flight-recorder postmortems: incident bundles +
+                      retention counters; obs/flight.py + incident.py,
+                      armed via node.cli --flight)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -304,12 +311,36 @@ class RpcServer:
             # pinned tracer (node.cli --trace) or the process-armed
             # one, exported as Chrome trace-event JSON — save the
             # result and open it in Perfetto. Null when no tracer.
+            # Optional params [trace_id?, limit?] scope the dump (a
+            # poller no longer has to pull the whole 4096-span ring);
+            # no params = the whole ring, unchanged.
             from ..obs import trace as obs_trace
 
             tracer = getattr(node, "tracer", None)
             if tracer is None:
                 tracer = obs_trace.armed_tracer()
-            return None if tracer is None else tracer.export_chrome()
+            if tracer is None:
+                return None
+            trace_id = params[0] if len(params) > 0 else None
+            limit = params[1] if len(params) > 1 else None
+            for v in (trace_id, limit):
+                if v is not None and not isinstance(v, int):
+                    raise RpcError(INVALID_PARAMS,
+                                   "expected [trace_id?, limit?] ints")
+            return tracer.export_chrome(trace_id=trace_id, limit=limit)
+        if method == "cess_incidentDump":
+            # flight-recorder postmortems (obs/incident.py): reporter
+            # counters, retention snapshot and the newest bundles
+            # (pinned traces, journal tails, metric deltas, fault
+            # log). Optional [limit] caps the bundle count. Null when
+            # the node runs without a reporter (node.cli --flight).
+            reporter = getattr(node, "incidents", None)
+            if reporter is None:
+                return None
+            limit = params[0] if params else None
+            if limit is not None and not isinstance(limit, int):
+                raise RpcError(INVALID_PARAMS, "expected [limit?] int")
+            return reporter.dump(limit=limit)
         if method == "cess_sloStatus":
             # SLO observability debug surface (obs/slo.py): per-class
             # burn rates / states / transition log + per-tenant

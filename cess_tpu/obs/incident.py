@@ -1,0 +1,281 @@
+"""Incident postmortems: triggers -> self-contained evidence bundles.
+
+obs/flight.py retains the evidence (pinned traces + the per-subsystem
+journal); this module decides when an *incident* happened and
+snapshots everything a postmortem needs into one bundle, at the
+moment of the trigger — not whenever a scrape happens to run.
+
+An :class:`IncidentReporter` registers as a journal listener on a
+:class:`~cess_tpu.obs.flight.FlightRecorder` and triggers on:
+
+==================  ========================================================
+trigger class       journal entry (subsystem, kind)
+==================  ========================================================
+``slo-burning``     ``("slo", "transition")`` with ``to == "burning"``
+``breaker-trip``    ``("breaker", "trip")`` (incl. ``force_open``)
+``breaker-hold``    ``("breaker", "hold")`` (the SLO-vacate latch)
+``shed-storm``      ``shed_storm`` consecutive ``("engine", "shed")``
+``invariant``       ``("sim", "invariant")`` (a chaos-world check failed)
+``thread-escape``   ``("engine"|"stream", "escape")`` — an exception
+                    escaping the batcher / stream driver
+==================  ========================================================
+
+Each bundle is self-contained: the pinned traces, the journal tail,
+metric deltas since the previous bundle, breaker / SLO / adaptive /
+admission snapshots, the fault plan's ``fired_log``, and — in sim
+runs — the scenario seed + witness needed to replay the episode
+(supplied by a ``context`` callable). Bundles are **rate-limited per
+trigger class** (``max_per_class``, count-based so replays agree) and
+**deduplicated** (a trigger repeating its class's previous key is
+dropped).
+
+Determinism: every bundle carries a ``canon`` section — the
+replay-stable view (trigger, key, journal entries from deterministic
+subsystems, the recorder's retention witness, the fired-fault log).
+:meth:`IncidentReporter.witness` serializes the canon sequence to
+bytes; two same-seed chaos runs must produce identical witnesses
+(tests/test_flight.py) — the ``fired_log`` contract of
+resilience/faults.py extended to whole postmortems. Host-timing data
+(span durations, latency metrics, the ``adaptive`` journal) rides in
+the bundle for humans but never in ``canon``.
+
+Surfaces: the ``cess_incidentDump`` RPC (node/rpc.py), ``node.cli
+--flight[=DIR]`` (bundles written to DIR as JSON on exit), and
+``sim.run_scenario`` reports. tools/incident_view.py renders a bundle
+as a human-readable timeline.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+
+from .trace import _json_safe
+
+# journal subsystems whose entries are replay-stable (the ``adaptive``
+# journal reacts to host-timed p99 estimates, so it is evidence, not
+# witness)
+_CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
+                        "finality", "flight"))
+
+
+def _sanitize(value):
+    """JSON-safe deep copy (dicts included — trace._json_safe handles
+    the scalar/bytes/sequence cases)."""
+    if isinstance(value, dict):
+        return {str(k): _sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    return _json_safe(value)
+
+
+def _flatten(prefix: str, value, out: dict) -> None:
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(value, bool):
+        pass
+    elif isinstance(value, (int, float)):
+        out[prefix] = float(value)
+
+
+class IncidentReporter:
+    """Turn notable journal entries into bounded, deduplicated,
+    rate-limited incident bundles.
+
+    recorder:      the FlightRecorder to listen on (evidence source).
+    engine:        optional SubmissionEngine — supplies breaker / SLO /
+                   adaptive / admission snapshots and the metric
+                   counters bundles diff.
+    board:         optional SloBoard when there is no engine (sim).
+    plan:          optional FaultPlan whose ``fired_log`` each bundle
+                   embeds (falls back to the process-armed plan).
+    context:       optional callable returning a dict merged into each
+                   bundle — sim runs supply the scenario seed +
+                   witness needed to replay the episode.
+    max_per_class: bundles per trigger class (count-based rate limit).
+    shed_storm:    consecutive engine sheds that constitute a storm.
+    """
+
+    def __init__(self, recorder, *, engine=None, board=None, plan=None,
+                 context=None, max_per_class: int = 4,
+                 max_bundles: int = 32, shed_storm: int = 8,
+                 journal_tail: int = 64):
+        if max_per_class < 1 or max_bundles < 1 or shed_storm < 1:
+            raise ValueError("incident reporter bounds must be >= 1")
+        self.recorder = recorder
+        self.engine = engine
+        self.board = board if board is not None \
+            else getattr(engine, "slo", None)
+        self.plan = plan
+        self.context = context
+        self.max_per_class = max_per_class
+        self.shed_storm = shed_storm
+        self.journal_tail = journal_tail
+        self._mu = threading.Lock()
+        self._bundles: collections.deque = collections.deque(
+            maxlen=max_bundles)
+        self._per_class: dict = {}
+        self._last_key: dict = {}
+        self._shed_run = 0
+        self._seq = 0
+        self._last_metrics: dict = {}
+        self.rate_limited = 0
+        self.deduplicated = 0
+        recorder.add_listener(self._on_note)
+
+    # -- the journal listener ------------------------------------------------
+    def _on_note(self, seq, subsystem, kind, detail) -> None:
+        if subsystem == "engine" and kind == "shed":
+            with self._mu:
+                self._shed_run += 1
+                storm = self._shed_run >= self.shed_storm
+                if storm:
+                    self._shed_run = 0
+            if storm:
+                self.trigger("shed-storm",
+                             key=f"{detail.get('cls')}:"
+                                 f"{detail.get('reason')}",
+                             detail=dict(detail,
+                                         storm=self.shed_storm))
+            return
+        if subsystem == "slo" and kind == "transition":
+            if detail.get("to") != "burning":
+                return
+            self.trigger("slo-burning", key=str(detail.get("cls")),
+                         detail=detail)
+        elif subsystem == "breaker" and kind in ("trip", "hold"):
+            self.trigger(f"breaker-{kind}",
+                         key=f"{detail.get('name')}:"
+                             f"{detail.get('reason', '')}",
+                         detail=detail)
+        elif subsystem == "sim" and kind == "invariant":
+            self.trigger("invariant", key=str(detail.get("context")),
+                         detail=detail)
+        elif kind == "escape" and subsystem in ("engine", "stream"):
+            self.trigger("thread-escape",
+                         key=f"{subsystem}:{detail.get('error')}",
+                         detail=dict(detail, thread=subsystem))
+
+    # -- triggering ----------------------------------------------------------
+    def trigger(self, cls: str, key: str, detail: dict) -> dict | None:
+        """Snapshot a bundle for trigger class ``cls`` unless the
+        class is rate-limited or ``key`` repeats the class's previous
+        trigger (dedup). Returns the bundle, or None when dropped."""
+        with self._mu:
+            if self._last_key.get(cls) == key:
+                self.deduplicated += 1
+                return None
+            if self._per_class.get(cls, 0) >= self.max_per_class:
+                self.rate_limited += 1
+                return None
+            self._per_class[cls] = self._per_class.get(cls, 0) + 1
+            self._last_key[cls] = key
+            self._seq += 1
+            seq = self._seq
+        # snapshot OUTSIDE self._mu: bundle assembly reads the
+        # recorder / board / breaker locks and must never nest them
+        # under the reporter's
+        bundle = self._build(seq, cls, key, detail)
+        with self._mu:
+            self._bundles.append(bundle)
+        return bundle
+
+    def _build(self, seq: int, cls: str, key: str, detail: dict) -> dict:
+        rec = self.recorder
+        journal = rec.journal_tail(limit=self.journal_tail)
+        pinned = rec.pinned()
+        plan = self.plan
+        if plan is None:
+            from ..resilience import faults as _faults
+            plan = _faults.armed_plan()
+        fired = [] if plan is None else [list(f) for f in plan.fired_log()]
+        snapshots: dict = {"flight": rec.snapshot()}
+        metrics: dict = {}
+        engine = self.engine
+        if engine is not None:
+            stats = engine.stats_snapshot()
+            _flatten("engine", stats, metrics)
+            snapshots["engine"] = stats
+            snapshots["breakers"] = {
+                name: mon.snapshot()
+                for name, mon in sorted(engine.monitors.items())}
+        elif self.board is not None:
+            _flatten("slo", self.board.snapshot(), metrics)
+        if self.board is not None:
+            snapshots["slo"] = self.board.snapshot()
+        adaptive = getattr(engine, "adaptive", None)
+        if adaptive is not None:
+            snapshots["adaptive"] = adaptive.snapshot()
+        admission = getattr(engine, "admission", None)
+        if admission is not None:
+            snapshots["admission"] = admission.snapshot()
+        with self._mu:
+            delta = {k: round(v - self._last_metrics.get(k, 0.0), 6)
+                     for k, v in metrics.items()
+                     if v != self._last_metrics.get(k, 0.0)}
+            self._last_metrics = metrics
+        context = {}
+        if self.context is not None:
+            context = _sanitize(self.context())
+        canon = {
+            "trigger": cls,
+            "key": key,
+            "detail": {k: repr(_json_safe(v))
+                       for k, v in sorted(detail.items())},
+            "journal": [[e["sys"], e["kind"],
+                         sorted((k, repr(v))
+                                for k, v in e["detail"].items())]
+                        for e in journal if e["sys"] in _CANON_SYS],
+            "pins": _sanitize(rec.witness()),
+            "faults": _sanitize(fired),
+            "context": context,
+        }
+        return {
+            "seq": seq,
+            "trigger": cls,
+            "key": key,
+            "detail": _sanitize(detail),
+            "journal": _sanitize(journal),
+            "pinned": _sanitize(pinned),
+            "metrics_delta": delta,
+            "snapshots": _sanitize(snapshots),
+            "faults": _sanitize(fired),
+            "context": context,
+            "canon": canon,
+        }
+
+    # -- introspection -------------------------------------------------------
+    def bundles(self) -> list[dict]:
+        with self._mu:
+            return list(self._bundles)
+
+    def witness(self) -> bytes:
+        """The replay witness: every retained bundle's ``canon``
+        section, serialized deterministically. Two same-seed runs of
+        the same episode must return identical bytes."""
+        with self._mu:
+            canons = [b["canon"] for b in self._bundles]
+        return json.dumps(canons, sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "bundles": len(self._bundles),
+                "triggers": dict(sorted(self._per_class.items())),
+                "rate_limited": self.rate_limited,
+                "deduplicated": self.deduplicated,
+            }
+
+    def dump(self, limit: int | None = None) -> dict:
+        """The ``cess_incidentDump`` RPC payload: reporter counters,
+        the recorder snapshot, and the newest ``limit`` bundles."""
+        bundles = self.bundles()
+        if limit is not None:
+            bundles = bundles[-limit:]
+        return {
+            "reporter": self.snapshot(),
+            "recorder": self.recorder.snapshot(),
+            "bundles": bundles,
+        }

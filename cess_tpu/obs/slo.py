@@ -57,6 +57,7 @@ import collections
 import dataclasses
 import threading
 
+from . import flight as _flight
 from . import prom
 from . import trace as _trace
 
@@ -360,6 +361,10 @@ class SloBoard:
         with _trace.span("slo.transition", sys="slo", cls=cls,
                          frm=old, to=new, burn=round(burn, 3)):
             pass
+        # ... a black-box journal entry (ok->burning is an incident
+        # trigger; burn is window-timing shaped, so it stays out of
+        # the replay-canonical detail) ...
+        _flight.note("slo", "transition", cls=cls, frm=old, to=new)
         # ... and a callback — the admission controller's seam
         with self._mu:
             listeners = list(self._listeners)

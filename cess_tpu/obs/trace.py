@@ -182,6 +182,14 @@ class Span:
                 _CURRENT.reset(token)
             except ValueError:
                 pass   # finished from another thread/context: fine
+        # the flight-recorder pin seam: one attribute load + None check
+        # when no recorder is attached (the zero-cost contract, pinned
+        # in tests/test_flight.py). Runs after _mu is released — the
+        # recorder takes its own lock. The idempotence guard above
+        # means a double finish() never reaches here twice.
+        fl = self.tracer.flight
+        if fl is not None:
+            fl.offer(self)
         return self
 
     def __enter__(self) -> "Span":
@@ -222,6 +230,16 @@ class Tracer:
         self.pid = os.getpid()
         self.started = 0                 # spans started (ever)
         self._dropped = 0                # finished spans the ring evicted
+        # optional obs.flight.FlightRecorder offered every finished
+        # span (tail-sampled retention); None = seam disabled
+        self.flight = None
+
+    def attach_flight(self, recorder) -> None:
+        """Attach an ``obs.flight.FlightRecorder``: every span finished
+        on this tracer is offered for tail-sampled retention (pinned
+        traces survive ring eviction in the recorder's own bounded
+        store). Pass None to detach."""
+        self.flight = recorder
 
     @property
     def dropped(self) -> int:
@@ -290,14 +308,45 @@ class Tracer:
                        for t, n, a in s.events],
         }
 
-    def export_chrome(self) -> dict:
+    def export_chrome(self, trace_id: int | None = None,
+                      limit: int | None = None) -> dict:
         """Chrome trace-event JSON (the ``{"traceEvents": [...]}``
         object form): one complete (``"ph": "X"``) event per finished
         span, microsecond timestamps relative to the tracer's origin.
         Write it to a file and open in Perfetto (ui.perfetto.dev) or
-        chrome://tracing; span attrs + events ride in ``args``."""
+        chrome://tracing; span attrs + events ride in ``args``.
+
+        trace_id: only spans of that trace (a distributed tracer may
+                  hold several); limit: newest ``limit`` spans after
+                  the filter — both optional, default = whole ring.
+
+        A span whose parent the bounded ring already evicted would
+        render as a dangling edge; such spans are re-parented to the
+        trace root (``"parent": 0``) with a synthetic
+        ``"truncated_parent": true`` arg so a wrapped ring stays
+        loadable in Perfetto and the truncation is visible per span
+        (tests/test_metrics.py pins the schema)."""
+        spans = self.finished()
+        if trace_id is not None:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+        if limit is not None:
+            spans = spans[-limit:]
+        present = {s["span_id"] for s in spans}
         events = []
-        for s in self.finished():
+        for s in spans:
+            args = {
+                "span_id": s["span_id"],
+                "parent": s["parent_id"],
+                "trace_id": s["trace_id"],
+                "remote_parent": s["remote_parent"],
+                "sys": s["sys"],
+                "events": s["events"],
+                **s["attrs"],
+            }
+            if s["parent_id"] != 0 and not s["remote_parent"] \
+                    and s["parent_id"] not in present:
+                args["parent"] = 0
+                args["truncated_parent"] = True
             events.append({
                 "name": s["name"],
                 "cat": s["sys"] or "span",
@@ -306,15 +355,7 @@ class Tracer:
                 "dur": round(s["dur_s"] * 1e6, 3),
                 "pid": self.pid,
                 "tid": s["tid"],
-                "args": {
-                    "span_id": s["span_id"],
-                    "parent": s["parent_id"],
-                    "trace_id": s["trace_id"],
-                    "remote_parent": s["remote_parent"],
-                    "sys": s["sys"],
-                    "events": s["events"],
-                    **s["attrs"],
-                },
+                "args": args,
             })
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 

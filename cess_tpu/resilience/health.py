@@ -29,6 +29,7 @@ import dataclasses
 import threading
 import typing
 
+from ..obs import flight as _flight
 from .retry import RetryPolicy
 from .stats import ResilienceStats
 
@@ -54,6 +55,10 @@ class HealthMonitor:
         self.error_threshold = error_threshold
         self.min_samples = min_samples
         self.probe_every = probe_every
+        # journal identity: the engine names its monitors ("codec",
+        # "audit") at registration so breaker journal entries and
+        # incident bundles say WHICH breaker moved
+        self.name = ""
         self._mu = threading.Lock()
         self._outcomes: collections.deque = \
             collections.deque(maxlen=window)      # (ok, latency_s)
@@ -96,6 +101,7 @@ class HealthMonitor:
 
     # -- outcomes -----------------------------------------------------------
     def record_success(self, latency_s: float = 0.0) -> None:
+        recovered = False
         with self._mu:
             self._outcomes.append((True, latency_s))
             # only an ADMITTED probe's success closes the breaker: an
@@ -106,9 +112,15 @@ class HealthMonitor:
                 self._state = "closed"
                 self._recoveries += 1
                 self._outcomes.clear()     # fresh window post-recovery
+                recovered = True
             self._probe_inflight = False
+        if recovered:
+            # journal notes ALWAYS run with self._mu released: the
+            # incident listener snapshots this very monitor
+            _flight.note("breaker", "recover", name=self.name)
 
     def record_error(self) -> None:
+        tripped = False
         with self._mu:
             self._outcomes.append((False, 0.0))
             self._probe_inflight = False
@@ -119,6 +131,10 @@ class HealthMonitor:
             if n >= self.min_samples \
                     and errs >= self.error_threshold * n:
                 self._trip_locked()
+                tripped = True
+        if tripped:
+            _flight.note("breaker", "trip", name=self.name,
+                         reason="error-window")
 
     def _trip_locked(self) -> None:
         self._state = "open"
@@ -135,11 +151,16 @@ class HealthMonitor:
         being vacated for higher-priority traffic. Idempotent; a hold
         over an already-tripped breaker just layers the latch (the
         trip's own recovery resumes on release)."""
+        latched = False
         with self._mu:
             if not self._held:
                 self._held = True
                 self._holds += 1
+                latched = True
             self._hold_reason = reason
+        if latched:
+            _flight.note("breaker", "hold", name=self.name,
+                         reason=reason)
 
     def release(self) -> None:
         """Drop the external latch. A breaker that was ALSO tripped by
@@ -155,14 +176,20 @@ class HealthMonitor:
                 self._outcomes.clear()
                 self._denied = 0
                 self._probe_inflight = False
+        _flight.note("breaker", "release", name=self.name)
 
     # -- manual control (bench/tests/ops) -----------------------------------
     def force_open(self) -> None:
         """Trip the breaker unconditionally (the bench's degraded-mode
         assertion, operator kill switches)."""
+        tripped = False
         with self._mu:
             if self._state == "closed":
                 self._trip_locked()
+                tripped = True
+        if tripped:
+            _flight.note("breaker", "trip", name=self.name,
+                         reason="forced")
 
     def force_close(self) -> None:
         with self._mu:

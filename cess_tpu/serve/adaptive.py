@@ -46,6 +46,7 @@ from __future__ import annotations
 import collections
 import threading
 
+from ..obs import flight as _flight
 from .policy import AdmissionPolicy
 
 
@@ -145,6 +146,7 @@ class AdaptiveBatchPolicy:
         """One resolved request's submit->resolve latency + its batch
         occupancy; every ``update_every``-th observation of a targeted
         class re-tunes the knobs."""
+        adjusted = None
         with self._mu:
             st = self._state_locked(cls)
             st["lats"].append(latency_s)
@@ -175,9 +177,15 @@ class AdaptiveBatchPolicy:
             if (delay, rows) != (st["delay"], st["rows"]):
                 st["delay"], st["rows"] = delay, rows
                 st["adjustments"] += 1
-                self._adjustments.append(
-                    (cls, st["count"], round(st["p99"], 6),
-                     round(delay, 6), rows))
+                adjusted = (cls, st["count"], round(st["p99"], 6),
+                            round(delay, 6), rows)
+                self._adjustments.append(adjusted)
+        if adjusted is not None:
+            # journal the knob change OUTSIDE self._mu (listener
+            # bundles read snapshot(), which takes it)
+            _flight.note("adaptive", "adjust", cls=adjusted[0],
+                         count=adjusted[1], p99=adjusted[2],
+                         delay=adjusted[3], rows=adjusted[4])
 
     # -- introspection -------------------------------------------------------
     def adjustment_log(self) -> tuple:

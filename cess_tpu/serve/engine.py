@@ -95,6 +95,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import trace
 from ..resilience import faults
 from ..resilience.retry import Budget
@@ -258,6 +259,7 @@ class SubmissionEngine:
                                                               "cpu")
                 self.monitors["audit"] = resilience.monitor()
             for name, mon in self.monitors.items():
+                mon.name = name   # black-box journal identity
                 resilience.stats.register_monitor(name, mon)
         if admission is not None:
             # after the monitors exist: the controller latches the
@@ -680,6 +682,20 @@ class SubmissionEngine:
             if reason is not None:
                 with self._lock:
                     self.stats.classes[cls].shed += 1
+                # a shed is an anomaly the flight recorder must keep:
+                # a marker span (tail-sampling pins on outcome="shed")
+                # plus a journal note — both OUTSIDE the engine lock
+                # (the shed-storm bundle reads stats_snapshot()).
+                tracer = self._tracer_now()
+                if tracer is not None:
+                    with tracer.start(f"engine.{cls}", sys="engine",
+                                      cls=cls, rows=rows, op=key[0],
+                                      outcome="shed",
+                                      reason=reason) as sp:
+                        if tenant is not None:
+                            sp.set(tenant=tenant)
+                _flight.note("engine", "shed", cls=cls, reason=reason,
+                             tenant=tenant)
                 raise EngineShed(f"{cls} request shed: {reason}")
         fut = EngineFuture()
         device = any(isinstance(a, jax.Array) for a in arrays.values())
@@ -698,6 +714,7 @@ class SubmissionEngine:
                 op=key[0])
             if tenant is not None:
                 req.span.set(tenant=tenant)
+        saturated = False
         with self._cond:
             if self._closed:
                 req.span.set(outcome="closed").finish()
@@ -705,12 +722,19 @@ class SubmissionEngine:
             st = self.stats.classes[cls]
             if len(self._queues[cls]) >= self.policy.queue_cap:
                 st.saturated += 1
-                req.span.set(outcome="saturated").finish()
-                raise EngineSaturated(
-                    f"{cls} queue full ({self.policy.queue_cap})")
-            st.submitted += 1
-            self._queues[cls].append(req)
-            self._cond.notify_all()
+                saturated = True
+            else:
+                st.submitted += 1
+                self._queues[cls].append(req)
+                self._cond.notify_all()
+        if saturated:
+            # span finish + journal note outside the engine lock: the
+            # recorder's listeners (incident bundles) read engine
+            # snapshots and must never nest under _cond
+            req.span.set(outcome="saturated").finish()
+            _flight.note("engine", "saturated", cls=cls)
+            raise EngineSaturated(
+                f"{cls} queue full ({self.policy.queue_cap})")
         return fut
 
     # -- batcher thread -------------------------------------------------
@@ -745,7 +769,16 @@ class SubmissionEngine:
                 continue
             try:
                 if batch:
-                    self._run_batch(batch)
+                    try:
+                        self._run_batch(batch)
+                    except BaseException as e:
+                        # an exception ESCAPING the batch runner (member
+                        # failures are isolated inside it) would kill
+                        # the batcher thread — exactly the black-box
+                        # moment: journal it before the thread dies so
+                        # the incident bundle carries the cause
+                        _flight.note("engine", "escape", error=repr(e))
+                        raise
             finally:
                 with self._cond:
                     self._inflight -= 1

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import trace
 from ..resilience import faults
 from .engine import _pad_axis0
@@ -285,6 +286,9 @@ class StreamingIngest:
                                         time.perf_counter() - bt0,
                                         ok=False, tenant=self.tenant,
                                         rows=real)
+                    # black-box journal: the exception is about to
+                    # escape the stream driver — an incident trigger
+                    _flight.note("stream", "escape", error=repr(e))
                     raise
                 dispatch = time.perf_counter() - t0
                 st.dispatch_s += dispatch

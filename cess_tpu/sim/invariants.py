@@ -26,6 +26,7 @@ Plus two supporting checks scenarios opt into: ``heads-converged``
 from __future__ import annotations
 
 from ..crypto.hashing import fragment_hash
+from ..obs import flight as _flight
 
 
 class InvariantViolation(AssertionError):
@@ -211,6 +212,12 @@ def run_checks(world, names, *, context: str = "",
     for name in names:
         violations.extend(f"[{context}] {v}" if context else v
                           for v in CHECKERS[name](world))
-    if violations and strict:
-        raise InvariantViolation("\n".join(violations))
+    if violations:
+        # black-box journal first: when strict mode raises, the
+        # incident trigger has already captured the evidence by the
+        # time the exception unwinds the scenario
+        _flight.note("sim", "invariant", context=context,
+                     violations=list(violations))
+        if strict:
+            raise InvariantViolation("\n".join(violations))
     return violations

@@ -25,11 +25,17 @@ import contextlib
 import dataclasses
 import hashlib
 
+from ..obs import flight as _flight
 from ..obs import trace
+from ..obs.incident import IncidentReporter
 from ..obs.slo import SloBoard, SloTarget
 from ..resilience import faults as _faults
-from .invariants import run_checks
+from .invariants import InvariantViolation, run_checks
 from .world import StorageProfile, World
+
+# seeded baseline pin fraction for scenario runs: 1/16 of healthy
+# round traces retained alongside every anomalous one
+_BASELINE_RATE = 0.0625
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +117,12 @@ class SimReport:
     plan: "_faults.FaultPlan | None"
     rounds_run: int
     uploads_active: int
+    # the flight-recorder layer (ISSUE 9): the run's FlightRecorder
+    # (pinned traces + journal) and its IncidentReporter (bundles) —
+    # reporter.witness() is the postmortem determinism contract,
+    # separate from the four run streams below
+    recorder: "_flight.FlightRecorder | None" = None
+    reporter: "IncidentReporter | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -217,11 +229,19 @@ def _apply_action(world: World, pending: dict, rnd: int,
 
 
 def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
-                 tracer=None, strict: bool = True) -> SimReport:
-    """Build the world, arm faults + tracer, interpret the timeline,
-    check invariants every round. Raises
+                 tracer=None, strict: bool = True,
+                 flight=None) -> SimReport:
+    """Build the world, arm faults + tracer + flight recorder,
+    interpret the timeline, check invariants every round. Raises
     :class:`~cess_tpu.sim.invariants.InvariantViolation` on the first
-    round whose checks fail (``strict=False`` collects instead)."""
+    round whose checks fail (``strict=False`` collects instead); the
+    raised exception carries ``.incidents`` (the bundles snapshotted
+    before the unwind) and ``.reporter``.
+
+    flight: an :class:`~cess_tpu.obs.flight.FlightRecorder` to arm for
+    the run; default builds one seeded from the scenario seed (so
+    retention replays bit-identically) with the scenario's SLO targets
+    as pin objectives."""
     seed_b = seed if isinstance(seed, bytes) else str(seed).encode()
     world = _build_world(scenario, seed_b, n_nodes)
     # tiny windows: scenario rounds produce a handful of observations
@@ -229,41 +249,70 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     board = SloBoard(tuple(SloTarget(cls, p99_s=p99)
                            for cls, p99 in scenario.slo),
                      fast_window=4, slow_window=16, eval_every=2)
+    recorder = flight if flight is not None else _flight.FlightRecorder(
+        seed_b, baseline_rate=_BASELINE_RATE,
+        objectives=dict(scenario.slo))
     plan = None
+    reporter = None
     stack = contextlib.ExitStack()
-    with stack:
-        if scenario.faults:
-            plan = _faults.FaultPlan.seeded(
-                seed_b, {site: (rate, kind)
-                         for site, rate, kind in scenario.faults},
-                horizon=256, clock=world.clock)
-            stack.enter_context(_faults.armed(plan))
-        if tracer is not None:
-            stack.enter_context(trace.armed(tracer))
-        pending: dict[bytes, _Upload] = {}
-        active = 0
-        for rnd in range(scenario.rounds):
-            # one scenario round = ONE connected trace: actions,
-            # authoring, gossip, agent reactions and invariant checks
-            # all hang off this root span
-            with trace.span("sim.round", sys="sim",
-                            scenario=scenario.name, round=rnd):
-                for row in scenario.timeline:
-                    if row[0] == rnd:
-                        _apply_action(world, pending, rnd,
-                                      row[1], tuple(row[2:]))
-                world.run_round()
-                active += _drive_uploads(world, pending, board, rnd)
-                board.observe("round",
-                              latency_s=float(world.last_round_slots))
-                run_checks(world, scenario.checks,
-                           context=f"{scenario.name}:round{rnd}",
-                           strict=strict)
-        run_checks(world, scenario.final_checks,
-                   context=f"{scenario.name}:final", strict=strict)
+    try:
+        with stack:
+            if scenario.faults:
+                plan = _faults.FaultPlan.seeded(
+                    seed_b, {site: (rate, kind)
+                             for site, rate, kind in scenario.faults},
+                    horizon=256, clock=world.clock)
+                stack.enter_context(_faults.armed(plan))
+            if tracer is not None:
+                stack.enter_context(trace.armed(tracer))
+                tracer.attach_flight(recorder)
+                stack.callback(tracer.attach_flight, None)
+            stack.enter_context(_flight.armed(recorder))
+            # each bundle embeds the scenario identity + the live
+            # witness streams — everything a replay needs
+            reporter = IncidentReporter(
+                recorder, board=board, plan=plan,
+                context=lambda: {
+                    "scenario": scenario.name,
+                    "seed": seed_b.hex(),
+                    "witness": (
+                        world.queue.fired_log(),
+                        world.finalized_prefix(),
+                        board.transition_log(),
+                        plan.fired_log() if plan is not None else ()),
+                })
+            pending: dict[bytes, _Upload] = {}
+            active = 0
+            for rnd in range(scenario.rounds):
+                # one scenario round = ONE connected trace: actions,
+                # authoring, gossip, agent reactions and invariant
+                # checks all hang off this root span
+                with trace.span("sim.round", sys="sim",
+                                scenario=scenario.name, round=rnd):
+                    for row in scenario.timeline:
+                        if row[0] == rnd:
+                            _apply_action(world, pending, rnd,
+                                          row[1], tuple(row[2:]))
+                    world.run_round()
+                    active += _drive_uploads(world, pending, board, rnd)
+                    board.observe("round",
+                                  latency_s=float(world.last_round_slots))
+                    run_checks(world, scenario.checks,
+                               context=f"{scenario.name}:round{rnd}",
+                               strict=strict)
+            run_checks(world, scenario.final_checks,
+                       context=f"{scenario.name}:final", strict=strict)
+    except InvariantViolation as e:
+        # the bundle was built by the strict-raise's journal note
+        # BEFORE the unwind; surface it on the exception so callers
+        # (and pytest failure output) hold the postmortem directly
+        e.reporter = reporter
+        e.incidents = [] if reporter is None else reporter.bundles()
+        raise
     return SimReport(scenario=scenario.name, seed=seed_b, world=world,
                      board=board, plan=plan, rounds_run=scenario.rounds,
-                     uploads_active=active)
+                     uploads_active=active, recorder=recorder,
+                     reporter=reporter)
 
 
 # -- the library --------------------------------------------------------------

@@ -71,6 +71,13 @@ def test_bench_smoke_every_metric_finite():
     assert traced["spans"] >= 1          # the armed run really traced
     assert math.isfinite(traced["untraced_GiBps"]) \
         and traced["untraced_GiBps"] > 0
+    # the retention-cost pin (ISSUE 9): the same run with a
+    # FlightRecorder attached — the overhead fraction is finite and
+    # the armed throughput is real
+    assert math.isfinite(traced["flight_overhead_frac"])
+    assert math.isfinite(traced["flight_GiBps"]) \
+        and traced["flight_GiBps"] > 0
+    assert traced["pinned"] >= 0
     # the adaptive-policy pin (ISSUE 6): sustained mixed traffic at a
     # fixed verify p99 target — the adaptive knobs beat the static
     # constants by a wide margin (the target itself is recorded, and

@@ -1,0 +1,627 @@
+"""Flight recorder + incident postmortems (ISSUE 9, cess_tpu/obs).
+
+Pins, in order: the zero-cost-when-off contract (the pin seam in
+``Span.finish`` and the module ``note`` hook are one load + None check
+when disarmed), the tail-sampling pin policy (anomaly outcomes,
+degraded batches, fault events, over-objective roots, the seeded
+baseline draw), anomaly-first budget eviction, the count-sequenced
+black-box journal, every IncidentReporter trigger class with dedup +
+rate limiting, bundle self-containment, RPC/CLI wire-up — and THE
+acceptance drill: the PR-6 chaos episode with the tracer ring sized
+so >90% of finished spans are evicted, where every anomalous trace
+survives complete and connected in the incident bundle and the whole
+postmortem replays byte-identically under the same seed. The sim
+integration (ISSUE 9 satellite): a tampered world's strict raise
+carries an incident bundle embedding the scenario witness, and two
+same-seed scenario runs produce identical bundle sequences.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from cess_tpu import obs
+from cess_tpu.obs import flight
+from cess_tpu.obs.incident import IncidentReporter
+from cess_tpu.obs.slo import SloBoard, SloTarget
+from cess_tpu.ops import podr2
+from cess_tpu.resilience import (FaultPlan, FaultSpec, ResilienceConfig,
+                                 faults)
+from cess_tpu.serve import (AdaptiveBatchPolicy, AdmissionController,
+                            AdmissionPolicy, EngineShed, make_engine)
+
+K, M = 2, 1
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    obs.disarm()
+    faults.disarm()
+    flight.disarm()
+
+
+def rnd(shape, seed=0, dtype=np.uint8):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, np.iinfo(dtype).max, shape, dtype=dtype)
+
+
+def _attached(tracer, seed=b"t", **kw):
+    rec = flight.FlightRecorder(seed, **kw)
+    tracer.attach_flight(rec)
+    return rec
+
+
+# -- disabled path: the zero-cost contract -----------------------------------
+class TestZeroCostWhenOff:
+    def test_tracer_carries_no_recorder_by_default(self):
+        tracer = obs.Tracer()
+        assert tracer.flight is None
+        tracer.start("x").finish()          # the pin seam no-ops
+        assert [s["name"] for s in tracer.finished()] == ["x"]
+
+    def test_module_hook_is_silent_when_disarmed(self):
+        flight.disarm()
+        assert flight.armed_recorder() is None
+        flight.note("engine", "shed", cls="encode")      # no-op
+
+    def test_armed_context_always_disarms(self):
+        rec = flight.FlightRecorder(b"t")
+        with flight.armed(rec) as r:
+            assert r is rec
+            assert flight.armed_recorder() is rec
+            flight.note("engine", "shed", cls="encode")
+        assert flight.armed_recorder() is None
+        assert [e["kind"] for e in rec.journal_tail()] == ["shed"]
+        with pytest.raises(RuntimeError):
+            with flight.armed(rec):
+                raise RuntimeError("boom")
+        assert flight.armed_recorder() is None           # even on unwind
+
+    def test_detach_stops_offers(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        tracer.start("a", outcome="error").finish()
+        tracer.attach_flight(None)
+        tracer.start("b", outcome="error").finish()
+        assert rec.offered == 1
+        assert len(rec.pinned()) == 1
+
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError):
+            flight.FlightRecorder(b"", baseline_rate=1.5)
+        with pytest.raises(ValueError):
+            flight.FlightRecorder(b"", pin_budget=0)
+        with pytest.raises(ValueError):
+            IncidentReporter(flight.FlightRecorder(b""), max_per_class=0)
+
+
+# -- the pin policy ----------------------------------------------------------
+class TestPinPolicy:
+    def test_error_outcome_pins_the_whole_trace(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        root = tracer.start("req", sys="engine", cls="verify")
+        tracer.start("dev", sys="device", parent=root).finish()
+        root.set(outcome="error").finish()
+        (p,) = rec.pinned()
+        assert p["root"] == "req"
+        assert p["reasons"] == ["error"]
+        assert p["anomalous"] is True
+        assert [s["name"] for s in p["spans"]] == ["req", "dev"]
+        assert rec.anomaly_pins == 1 and rec.baseline_pins == 0
+
+    def test_every_bad_outcome_pins(self):
+        for outcome in ("error", "timeout", "saturated", "shed", "closed"):
+            tracer = obs.Tracer()
+            rec = _attached(tracer)
+            tracer.start("req", outcome=outcome).finish()
+            assert [p["reasons"] for p in rec.pinned()] == [[outcome]]
+
+    def test_ok_trace_drops_without_a_baseline_rate(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        tracer.start("req", outcome="ok").finish()
+        assert rec.pinned() == []
+        assert rec.roots_seen == 1 and rec.offered == 1
+
+    def test_child_anomaly_pins_even_when_the_root_is_ok(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        root = tracer.start("req")
+        tracer.start("inner", parent=root).set(degraded=True).finish()
+        root.set(outcome="ok").finish()
+        (p,) = rec.pinned()
+        assert p["reasons"] == ["degraded"]
+        assert {s["name"] for s in p["spans"]} == {"req", "inner"}
+
+    def test_fault_event_pins(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        sp = tracer.start("req")
+        sp.event("fault", site="engine.dispatch")
+        sp.finish()
+        (p,) = rec.pinned()
+        assert p["reasons"] == ["fault"]
+
+    def test_error_attr_pins_when_there_is_no_outcome(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        tracer.start("req", error="ValueError('x')").finish()
+        (p,) = rec.pinned()
+        assert p["reasons"] == ["error"]
+
+    def test_late_children_attach_to_an_already_pinned_trace(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer)
+        root = tracer.start("req")
+        root.set(outcome="shed").finish()
+        tracer.start("flush", parent=root).set(degraded=True).finish()
+        (p,) = rec.pinned()
+        assert {s["name"] for s in p["spans"]} == {"req", "flush"}
+        assert p["reasons"] == ["degraded", "shed"]
+
+    def test_over_objective_pins_but_stays_out_of_the_witness(self):
+        import time
+
+        tracer = obs.Tracer()
+        rec = _attached(tracer, objectives={"verify": 0.0})
+        sp = tracer.start("req", cls="verify")
+        time.sleep(0.002)
+        sp.finish()
+        (p,) = rec.pinned()
+        assert p["reasons"] == ["over-objective"]
+        # host timing never enters the replay witness
+        assert rec.witness() == ()
+
+    def test_baseline_rate_one_pins_everything_rate_zero_nothing(self):
+        for rate, want in ((1.0, 1), (0.0, 0)):
+            tracer = obs.Tracer()
+            rec = _attached(tracer, baseline_rate=rate)
+            tracer.start("req", outcome="ok").finish()
+            assert len(rec.pinned()) == want
+        tracer = obs.Tracer()
+        rec = _attached(tracer, baseline_rate=1.0)
+        tracer.start("req", outcome="ok").finish()
+        (p,) = rec.pinned()
+        assert p["reasons"] == ["baseline"]
+        assert p["anomalous"] is False
+
+    def test_baseline_draw_is_seeded_and_replayable(self):
+        def run(seed):
+            tracer = obs.Tracer()
+            rec = _attached(tracer, seed=seed, baseline_rate=0.5)
+            for i in range(32):
+                tracer.start(f"req{i}", outcome="ok").finish()
+            return tuple(p["root_span_id"] for p in rec.pinned())
+
+        a = run(b"seed-A")
+        assert a == run(b"seed-A")          # same seed, same retained set
+        assert 0 < len(a) < 32              # a FRACTION, not all-or-nothing
+        assert a != run(b"seed-B")          # the draw is seed-keyed
+
+
+class TestPinBudget:
+    def test_budget_evicts_baseline_before_anomaly(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer, baseline_rate=1.0, pin_budget=2)
+        tracer.start("base1", outcome="ok").finish()
+        tracer.start("anom", outcome="error").finish()
+        tracer.start("base2", outcome="ok").finish()
+        assert [p["root"] for p in rec.pinned()] == ["anom", "base2"]
+        assert rec.pin_evictions == 1
+
+    def test_anomalies_age_out_only_among_themselves(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer, pin_budget=1)
+        tracer.start("anom1", outcome="error").finish()
+        tracer.start("anom2", outcome="timeout").finish()
+        (p,) = rec.pinned()
+        assert p["root"] == "anom2"
+        assert rec.pin_evictions == 1
+
+    def test_a_single_over_budget_trace_is_never_truncated(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer, pin_budget=1)
+        root = tracer.start("req")
+        for i in range(3):
+            tracer.start(f"c{i}", parent=root).finish()
+        root.set(outcome="error").finish()
+        (p,) = rec.pinned()
+        assert len(p["spans"]) == 4         # kept whole, budget or not
+
+    def test_pending_cap_bounds_unrooted_spans(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer, pending_cap=2)
+        root = tracer.start("req")
+        for i in range(3):
+            tracer.start(f"c{i}", parent=root).finish()
+        assert rec.pending_evictions == 1   # c0 (oldest) evicted
+        root.set(outcome="error").finish()
+        (p,) = rec.pinned()
+        assert {s["name"] for s in p["spans"]} == {"req", "c1", "c2"}
+
+
+# -- the black-box journal ---------------------------------------------------
+class TestJournal:
+    def test_entries_are_count_sequenced_and_merged(self):
+        rec = flight.FlightRecorder(b"j")
+        rec.note("engine", "shed", cls="encode")
+        rec.note("breaker", "trip", name="codec")
+        rec.note("engine", "saturated", cls="prove")
+        assert [(e["seq"], e["sys"], e["kind"])
+                for e in rec.journal_tail()] == [
+            (1, "engine", "shed"), (2, "breaker", "trip"),
+            (3, "engine", "saturated")]
+        assert rec.journal_tail("breaker") == [
+            {"seq": 2, "sys": "breaker", "kind": "trip",
+             "detail": {"name": "codec"}}]
+        assert [e["seq"] for e in rec.journal_tail(limit=2)] == [2, 3]
+
+    def test_journal_cap_bounds_each_subsystem(self):
+        rec = flight.FlightRecorder(b"j", journal_cap=2)
+        for i in range(4):
+            rec.note("engine", "shed", i=i)
+        # bounded window, global sequence numbers intact
+        assert [e["seq"] for e in rec.journal_tail("engine")] == [3, 4]
+        assert rec.snapshot()["journal_entries"] == 4
+
+    def test_listeners_receive_entries_in_sequence(self):
+        rec = flight.FlightRecorder(b"j")
+        got = []
+        rec.add_listener(lambda seq, sys_, kind, detail:
+                         got.append((seq, sys_, kind, dict(detail))))
+        rec.note("slo", "transition", cls="verify", frm="ok", to="burning")
+        rec.note("engine", "shed", cls="encode")
+        assert got == [
+            (1, "slo", "transition",
+             {"cls": "verify", "frm": "ok", "to": "burning"}),
+            (2, "engine", "shed", {"cls": "encode"})]
+
+
+# -- incident triggers -------------------------------------------------------
+def _pair(**kw):
+    rec = flight.FlightRecorder(b"inc")
+    return rec, IncidentReporter(rec, **kw)
+
+
+class TestIncidentTriggers:
+    def test_slo_burning_triggers_and_dedups_per_key(self):
+        rec, rep = _pair()
+        rec.note("slo", "transition", cls="verify", frm="ok", to="burning")
+        rec.note("slo", "transition", cls="verify", frm="burning", to="warn")
+        (b,) = rep.bundles()
+        assert b["trigger"] == "slo-burning" and b["key"] == "verify"
+        # the SAME class burning again repeats the previous key: dedup
+        rec.note("slo", "transition", cls="verify", frm="warn", to="burning")
+        assert len(rep.bundles()) == 1
+        assert rep.snapshot()["deduplicated"] == 1
+        # a different class is its own incident
+        rec.note("slo", "transition", cls="encode", frm="ok", to="burning")
+        assert [b["key"] for b in rep.bundles()] == ["verify", "encode"]
+
+    def test_breaker_trip_and_hold_trigger_recover_does_not(self):
+        rec, rep = _pair()
+        rec.note("breaker", "trip", name="codec", reason="error-window")
+        rec.note("breaker", "hold", name="codec", reason="slo:verify")
+        rec.note("breaker", "recover", name="codec")
+        rec.note("breaker", "release", name="codec")
+        assert [b["trigger"] for b in rep.bundles()] == \
+            ["breaker-trip", "breaker-hold"]
+
+    def test_shed_storm_counts_consecutive_sheds(self):
+        rec, rep = _pair(shed_storm=3)
+        for _ in range(2):
+            rec.note("engine", "shed", cls="encode", reason="slo-burning",
+                     tenant="bulk")
+        assert rep.bundles() == []          # below the storm threshold
+        rec.note("engine", "shed", cls="encode", reason="slo-burning",
+                 tenant="bulk")
+        (b,) = rep.bundles()
+        assert b["trigger"] == "shed-storm"
+        assert b["key"] == "encode:slo-burning"
+        assert b["detail"]["storm"] == 3
+
+    def test_invariant_and_thread_escape_triggers(self):
+        rec, rep = _pair()
+        rec.note("sim", "invariant", context="s:round1", violations=["x"])
+        rec.note("engine", "escape", error="RuntimeError('boom')")
+        rec.note("stream", "escape", error="RuntimeError('pow')")
+        assert [b["trigger"] for b in rep.bundles()] == \
+            ["invariant", "thread-escape", "thread-escape"]
+        assert rep.bundles()[1]["detail"]["thread"] == "engine"
+
+    def test_rate_limit_per_class(self):
+        rec, rep = _pair(max_per_class=1)
+        rec.note("breaker", "trip", name="a", reason="r")
+        rec.note("breaker", "trip", name="b", reason="r")
+        assert len(rep.bundles()) == 1
+        assert rep.snapshot()["rate_limited"] == 1
+
+    def test_bundle_is_self_contained_and_json_serializable(self):
+        tracer = obs.Tracer()
+        rec = _attached(tracer, seed=b"inc")
+        rep = IncidentReporter(rec)
+        tracer.start("req", sys="engine", cls="verify",
+                     outcome="error").finish()
+        rec.note("slo", "transition", cls="verify", frm="ok", to="burning")
+        (b,) = rep.bundles()
+        assert set(b) == {"seq", "trigger", "key", "detail", "journal",
+                          "pinned", "metrics_delta", "snapshots", "faults",
+                          "context", "canon"}
+        assert b["pinned"][0]["reasons"] == ["error"]
+        assert b["snapshots"]["flight"]["pins"] == 1
+        assert [j["kind"] for j in b["journal"]] == ["transition"]
+        json.dumps(b)       # must survive the RPC / --flight artifact path
+
+    def test_witness_bytes_are_deterministic(self):
+        def run():
+            rec, rep = _pair()
+            rec.note("slo", "transition", cls="verify", frm="ok",
+                     to="burning")
+            rec.note("breaker", "hold", name="codec", reason="slo:verify")
+            return rep.witness()
+
+        w = run()
+        assert isinstance(w, bytes)
+        assert w == run()
+
+    def test_dump_payload_and_limit(self):
+        rec, rep = _pair()
+        rec.note("breaker", "trip", name="a", reason="r")
+        rec.note("breaker", "hold", name="a", reason="h")
+        dump = rep.dump(limit=1)
+        assert set(dump) == {"reporter", "recorder", "bundles"}
+        assert [b["trigger"] for b in dump["bundles"]] == ["breaker-hold"]
+        assert rep.dump()["reporter"]["bundles"] == 2
+
+
+# -- wire-up: RPC methods + CLI flag -----------------------------------------
+class TestRpcSurface:
+    def test_trace_dump_params_scope_the_dump(self):
+        from cess_tpu.node.chain_spec import dev_spec
+        from cess_tpu.node.network import Node
+        from cess_tpu.node.rpc import RpcError, RpcServer
+
+        node = Node(dev_spec(), "rpc-node", {})
+        rpc = RpcServer(node, port=0).start()
+        try:
+            tracer = obs.Tracer()
+            for name in ("a", "b", "c"):
+                tracer.start(name).finish()
+            node.tracer = tracer
+            full = rpc.handle("cess_traceDump", [])
+            assert [e["name"] for e in full["traceEvents"]] == \
+                ["a", "b", "c"]
+            newest = rpc.handle("cess_traceDump", [None, 2])
+            assert [e["name"] for e in newest["traceEvents"]] == ["b", "c"]
+            scoped = rpc.handle("cess_traceDump", [tracer.trace_id])
+            assert len(scoped["traceEvents"]) == 3
+            assert rpc.handle("cess_traceDump", [999])["traceEvents"] == []
+            with pytest.raises(RpcError):
+                rpc.handle("cess_traceDump", ["x"])
+        finally:
+            rpc.stop()
+
+    def test_incident_dump_serves_the_node_reporter(self):
+        from cess_tpu.node.chain_spec import dev_spec
+        from cess_tpu.node.network import Node
+        from cess_tpu.node.rpc import RpcError, RpcServer
+
+        node = Node(dev_spec(), "rpc-node", {})
+        rpc = RpcServer(node, port=0).start()
+        try:
+            assert rpc.handle("cess_incidentDump", []) is None
+            rec = flight.FlightRecorder(b"rpc")
+            rep = IncidentReporter(rec)
+            rec.note("breaker", "trip", name="codec", reason="r")
+            rec.note("breaker", "hold", name="codec", reason="h")
+            node.incidents = rep
+            dump = rpc.handle("cess_incidentDump", [])
+            assert [b["trigger"] for b in dump["bundles"]] == \
+                ["breaker-trip", "breaker-hold"]
+            assert dump["reporter"]["bundles"] == 2
+            limited = rpc.handle("cess_incidentDump", [1])
+            assert [b["trigger"] for b in limited["bundles"]] == \
+                ["breaker-hold"]
+            with pytest.raises(RpcError):
+                rpc.handle("cess_incidentDump", ["x"])
+        finally:
+            rpc.stop()
+
+
+class TestCliFlag:
+    def test_flight_requires_trace(self):
+        from cess_tpu.node.cli import main
+
+        with pytest.raises(SystemExit) as ei:
+            main(["--dev", "--blocks", "1", "--flight"])
+        assert ei.value.code == 2
+
+    def test_arm_is_a_noop_without_the_flag(self):
+        import argparse
+
+        from cess_tpu.node.cli import _arm_cli_flight
+
+        args = argparse.Namespace(flight=None)
+        assert _arm_cli_flight(args, None, None) == (None, None)
+        assert flight.armed_recorder() is None
+
+    def test_cli_flight_run_writes_artifacts_and_disarms(self, tmp_path):
+        from cess_tpu.node.cli import main
+
+        out = tmp_path / "incidents"
+        trace_path = tmp_path / "trace.json"
+        assert main(["--dev", "--blocks", "2", f"--trace={trace_path}",
+                     f"--flight={out}"]) == 0
+        assert flight.armed_recorder() is None      # disarmed on exit
+        assert obs.armed_tracer() is None
+        assert out.is_dir()
+        for p in out.glob("incident_*.json"):
+            bundle = json.loads(p.read_text())
+            assert "trigger" in bundle and "canon" in bundle
+
+
+# -- THE acceptance: the chaos drill under a tiny ring -----------------------
+OBJECTIVE_S = 0.30      # verify p99 objective (the test_slo drill values:
+                        # ~6x the CPU-jax verify dispatch floor)
+FAULT_DELAY_S = 0.70    # injected dispatch slowness: ~2.3x objective
+RING = 12               # tracer ring capacity: sized so the episode
+                        # evicts >90% of finished spans — the flight
+                        # recorder must be the only survivor store
+
+
+def _run_flight_drill(seed: bytes):
+    """The PR-6 SLO drill with the flight recorder armed over a
+    deliberately tiny tracer ring; returns (recorder, reporter,
+    ring spans, dropped count, shed count)."""
+    pkey = podr2.Podr2Key.generate(44)
+    params = podr2.Podr2Params()
+    blocks = params.blocks_for(512)
+    ids = np.stack([np.arange(2, dtype=np.uint32),
+                    np.zeros(2, dtype=np.uint32)], axis=1)
+    idx, nu = podr2.gen_challenge(b"flight-drill", blocks)
+    mu = np.zeros((2, params.sectors), dtype=np.uint32)
+    sigma = np.zeros((2, podr2.LIMBS), dtype=np.uint32)
+
+    board = SloBoard((SloTarget("verify", OBJECTIVE_S, 0.01),),
+                     fast_window=4, slow_window=16, eval_every=4)
+    adaptive = AdaptiveBatchPolicy(board=board)
+    admission = AdmissionController(board, adaptive,
+                                    protect=("verify",), shed=("encode",))
+    tracer = obs.Tracer(capacity=RING)
+    recorder = flight.FlightRecorder(seed, baseline_rate=1 / 8,
+                                     objectives={"verify": OBJECTIVE_S})
+    tracer.attach_flight(recorder)
+    eng = make_engine(K, M, rs_backend="jax", podr2_key=pkey,
+                      policy=AdmissionPolicy(max_delay=0.002),
+                      resilience=ResilienceConfig(),
+                      tracer=tracer, slo=board, adaptive=adaptive,
+                      admission=admission)
+    reporter = IncidentReporter(recorder, engine=eng, shed_storm=4)
+    plan = FaultPlan.seeded(seed, {
+        "engine.dispatch": (1.0, FaultSpec("delay",
+                                           delay_s=FAULT_DELAY_S)),
+    }, horizon=64)
+    bulk = rnd((1, K, 512), 7)
+    sheds = 0
+    try:
+        with obs.armed(tracer), flight.armed(recorder):
+            # -- phase 1: every device dispatch is slow ------------------
+            with faults.armed(plan):
+                for _ in range(8):
+                    try:
+                        eng.encode(bulk, timeout=30, tenant="bulk")
+                    except EngineShed:
+                        sheds += 1
+                    eng.verify_batch(ids, blocks, idx, nu, mu, sigma,
+                                     timeout=30, tenant="auditor")
+                assert board.state("verify") == "burning"
+                assert eng.monitors["codec"].state == "held"
+                # surviving codec traffic serves CPU-degraded
+                shards = np.asarray(eng._fallback_codec.encode(bulk))
+                eng.reconstruct(shards[:, (0, 1)], (0, 1), (2,),
+                                timeout=30, tenant="repairer")
+            # -- phase 2: the device is healthy again --------------------
+            for _ in range(20):
+                try:
+                    eng.encode(bulk, timeout=30, tenant="bulk")
+                except EngineShed:
+                    sheds += 1
+                eng.verify_batch(ids, blocks, idx, nu, mu, sigma,
+                                 timeout=30, tenant="auditor")
+        ring = tracer.finished()
+        dropped = tracer.dropped
+    finally:
+        eng.close()
+    return recorder, reporter, ring, dropped, sheds
+
+
+def test_flight_drill_pins_survive_ring_eviction_and_replay():
+    rec1, rep1, ring, dropped, sheds = _run_flight_drill(b"flight-drill")
+
+    # the ring was sized to lose the episode: >90% of finished spans
+    # were evicted, so the raw tracer CANNOT answer the postmortem
+    assert dropped / (dropped + len(ring)) > 0.9
+
+    # every pinned trace survives COMPLETE and CONNECTED: each span's
+    # parent is the root sentinel or inside the same pin
+    pins = rec1.pinned()
+    assert pins
+    assert rec1.pending_evictions == 0
+    for p in pins:
+        span_ids = {s["span_id"] for s in p["spans"]}
+        assert p["root_span_id"] in span_ids
+        for s in p["spans"]:
+            assert (s["parent_id"] == 0 or s["remote_parent"]
+                    or s["parent_id"] in span_ids), \
+                f"pin {p['root']!r}: span {s['name']!r} lost its parent"
+
+    # the episode's anomaly classes are all retained
+    reasons = {r for p in pins for r in p["reasons"]}
+    assert {"shed", "degraded", "fault", "over-objective"} <= reasons
+
+    # the incident bundles cover the episode's trigger classes
+    assert sheds >= 4
+    triggers = {b["trigger"] for b in rep1.bundles()}
+    assert {"slo-burning", "breaker-hold", "shed-storm"} <= triggers
+    burning = next(b for b in rep1.bundles()
+                   if b["trigger"] == "slo-burning")
+    assert burning["pinned"], "the bundle must embed the pinned evidence"
+    assert burning["snapshots"]["breakers"]
+    assert burning["snapshots"]["slo"]
+    assert burning["faults"], "the seeded fault log rides in the bundle"
+    json.dumps(burning)
+
+    # byte-identical replay: same seed, same retention, same postmortems
+    rec2, rep2, _, _, sheds2 = _run_flight_drill(b"flight-drill")
+    assert sheds2 == sheds
+    assert rec2.witness() == rec1.witness()
+    assert rep2.witness() == rep1.witness()
+
+
+# -- sim integration: postmortems for chaos worlds ---------------------------
+class TestSimIntegration:
+    def test_tampered_world_yields_incident_with_scenario_witness(self):
+        from cess_tpu.sim import scenarios
+        from cess_tpu.sim.invariants import CHECKERS, InvariantViolation
+
+        sc = scenarios.Scenario(name="tampered", rounds=3,
+                                checks=("finalized-prefix", "tampered"))
+        CHECKERS["tampered"] = lambda world: ["tampered: injected"]
+        try:
+            with pytest.raises(InvariantViolation, match="tampered") as ei:
+                scenarios.run_scenario(sc, b"tampered", n_nodes=20)
+        finally:
+            del CHECKERS["tampered"]
+        e = ei.value
+        assert e.reporter is not None
+        assert e.incidents, "the strict raise must carry the postmortem"
+        b = e.incidents[0]
+        assert b["trigger"] == "invariant"
+        assert b["key"] == "tampered:round0"
+        assert "tampered: injected" in b["detail"]["violations"][0]
+        ctx = b["context"]
+        assert ctx["scenario"] == "tampered"
+        assert ctx["seed"] == b"tampered".hex()
+        assert len(ctx["witness"]) == 4         # the four replay streams
+        assert b["canon"]["context"] == ctx
+        json.dumps(b)
+        # the scenario stack unwound cleanly: nothing stays armed
+        assert flight.armed_recorder() is None
+
+    def test_same_seed_scenario_runs_replay_identical_postmortems(self):
+        from cess_tpu.sim.scenarios import SCENARIOS, run_scenario
+
+        def run():
+            tracer = obs.Tracer(capacity=65536)
+            return run_scenario(SCENARIOS["gateway_hotspot"],
+                                b"flight-replay", n_nodes=20,
+                                tracer=tracer)
+
+        a, b = run(), run()
+        assert a.recorder is not None and a.reporter is not None
+        assert a.recorder.offered > 0       # the tracer fed the recorder
+        assert a.recorder.witness() == b.recorder.witness()
+        assert a.reporter.witness() == b.reporter.witness()
+        assert a.witness() == b.witness()   # the PR-8 contract still holds

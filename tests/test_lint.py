@@ -623,6 +623,33 @@ class TestSimDeterminism:
         # node/ legitimately sleeps and reads wall clocks
         assert lint(DIRTY_SIM, "cess_tpu/node/fixture.py").findings == []
 
+    def test_retention_layer_joins_the_family(self):
+        """ISSUE 9: the flight recorder's pin/bundle decisions are
+        under the same replay contract as sim worlds — the determinism
+        rules fire at obs/flight.py and obs/incident.py, the clean
+        (seeded SHA-256) twin stays silent there, and the rest of
+        obs/ (which legitimately reads the wall clock for span
+        timing) is untouched."""
+        for path in ("cess_tpu/obs/flight.py",
+                     "cess_tpu/obs/incident.py"):
+            assert rules_at(lint(DIRTY_SIM, path)) == \
+                {"sim-wallclock", "sim-entropy"}, path
+            assert lint(CLEAN_SIM, path).findings == []
+        assert lint(DIRTY_SIM, "cess_tpu/obs/trace.py").findings == []
+
+    def test_retention_modules_scan_clean(self):
+        """ISSUE 9 satellite: the shipped retention layer passes its
+        own determinism family (plus every other applicable rule)
+        with zero suppressions; baseline stays empty."""
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "obs", "flight.py"),
+             os.path.join(REPO, "cess_tpu", "obs", "incident.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_sim_package_is_clean(self):
         """ISSUE 8 satellite: the whole sim harness scans clean under
         its own determinism family PLUS trace-safety and

@@ -311,6 +311,29 @@ class TestChromeExport:
             == batch[0]["args"]["span_id"]
         assert batch[0]["args"]["parent"] == req[0]["args"]["span_id"]
 
+    def test_ring_evicted_parent_is_marked_truncated(self):
+        """ISSUE 9 satellite: a span whose parent was evicted by the
+        bounded ring used to export a dangling parent id Perfetto
+        renders as a broken edge — it is now re-rooted with an
+        explicit ``truncated_parent`` marker, so eviction is visible
+        instead of corrupting the tree."""
+        tracer = obs.Tracer(capacity=1)
+        root = tracer.start("root", sys="test")
+        child = tracer.start("child", sys="test", parent=root)
+        root.finish()
+        child.finish()                  # evicts the root's record
+        (ev,) = tracer.export_chrome()["traceEvents"]
+        assert ev["name"] == "child"
+        assert ev["args"]["parent"] == 0
+        assert ev["args"]["truncated_parent"] is True
+        # a parent that IS in the dump is never marked
+        tracer2 = obs.Tracer(capacity=16)
+        r2 = tracer2.start("root", sys="test")
+        tracer2.start("child", sys="test", parent=r2).finish()
+        r2.finish()
+        for ev in tracer2.export_chrome()["traceEvents"]:
+            assert "truncated_parent" not in ev["args"]
+
 
 # -- ISSUE 6 satellites: merge error path, label escaping, ring drops --------
 class TestHistogramMergeBounds:

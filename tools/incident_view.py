@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Render a flight-recorder incident bundle as a human timeline.
+
+Input: a JSON file holding either ONE bundle (the per-incident files
+``node.cli --flight=DIR`` writes) or a ``cess_incidentDump`` payload
+(``{"reporter": ..., "recorder": ..., "bundles": [...]}``) — the tool
+renders every bundle it finds. Stdlib only; read-only.
+
+    python tools/incident_view.py run/incident_001_slo-burning.json
+    python tools/incident_view.py dump.json --bundle 2 --journal 50
+
+The timeline interleaves the black-box journal (count-sequenced, so
+order is exact even though there are no timestamps) with the trigger
+itself, then summarizes the retained evidence: pinned traces (span
+trees with anomaly reasons), metric deltas since the previous bundle,
+fired faults, and subsystem snapshots.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_bundles(path: str) -> list[dict]:
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict) and "bundles" in payload:
+        return list(payload["bundles"])
+    if isinstance(payload, dict) and "trigger" in payload:
+        return [payload]
+    raise SystemExit(f"{path}: neither an incident bundle nor a "
+                     "cess_incidentDump payload")
+
+
+def _fmt_detail(detail: dict) -> str:
+    return " ".join(f"{k}={v!r}" for k, v in sorted(detail.items()))
+
+
+def _render_journal(bundle: dict, limit: int, out) -> None:
+    entries = bundle.get("journal", [])[-limit:]
+    print(f"  journal (last {len(entries)} entries, seq order):",
+          file=out)
+    for e in entries:
+        print(f"    #{e['seq']:>5}  {e['sys']:<9} {e['kind']:<12} "
+              f"{_fmt_detail(e.get('detail', {}))}", file=out)
+
+
+def _render_pins(bundle: dict, out) -> None:
+    pins = bundle.get("pinned", [])
+    print(f"  pinned traces ({len(pins)}):", file=out)
+    for p in pins:
+        flag = "ANOMALY " if p.get("anomalous") else "baseline"
+        print(f"    [{flag}] trace={p['trace_id']} root={p['root']!r} "
+              f"reasons={','.join(p['reasons'])} "
+              f"spans={len(p['spans'])}", file=out)
+        by_parent: dict = {}
+        for s in p["spans"]:
+            by_parent.setdefault(s["parent_id"], []).append(s)
+
+        def walk(parent_id, depth):
+            for s in sorted(by_parent.get(parent_id, []),
+                            key=lambda x: x["span_id"]):
+                attrs = s.get("attrs", {})
+                mark = "".join(
+                    f" {k}={attrs[k]!r}" for k in
+                    ("outcome", "cls", "reason", "degraded", "error")
+                    if k in attrs)
+                print(f"      {'  ' * depth}- {s['name']} "
+                      f"({s['dur_s'] * 1e3:.2f} ms){mark}", file=out)
+                walk(s["span_id"], depth + 1)
+
+        walk(p["root_span_id"], 0)
+        # spans whose parent is outside the pin (pre-attach ancestors)
+        roots = {s["span_id"] for s in p["spans"]}
+        for s in p["spans"]:
+            if s["parent_id"] not in roots \
+                    and s["span_id"] != p["root_span_id"]:
+                walk(s["span_id"], 0)
+
+
+def _render_bundle(bundle: dict, journal_limit: int, out) -> None:
+    print(f"incident #{bundle['seq']}: {bundle['trigger']} "
+          f"(key={bundle['key']!r})", file=out)
+    print(f"  detail: {_fmt_detail(bundle.get('detail', {}))}",
+          file=out)
+    ctx = bundle.get("context") or {}
+    if ctx:
+        scenario = ctx.get("scenario")
+        seed = ctx.get("seed")
+        if scenario is not None:
+            print(f"  scenario: {scenario} seed={seed} "
+                  "(witness embedded — replay with "
+                  "sim.run_scenario)", file=out)
+    _render_journal(bundle, journal_limit, out)
+    _render_pins(bundle, out)
+    delta = bundle.get("metrics_delta", {})
+    if delta:
+        print(f"  metric deltas since previous bundle:", file=out)
+        for k in sorted(delta):
+            print(f"    {k:<48} {delta[k]:+g}", file=out)
+    faults = bundle.get("faults", [])
+    if faults:
+        print(f"  fired faults ({len(faults)}):", file=out)
+        for f in faults:
+            print(f"    {f}", file=out)
+    snaps = bundle.get("snapshots", {})
+    for name in ("breakers", "slo", "adaptive", "admission", "flight"):
+        if name in snaps:
+            print(f"  {name} snapshot: "
+                  f"{json.dumps(snaps[name], sort_keys=True)}",
+                  file=out)
+    print(file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render flight-recorder incident bundles as "
+                    "human-readable timelines")
+    ap.add_argument("path", help="bundle JSON (node.cli --flight=DIR "
+                                 "artifact) or cess_incidentDump "
+                                 "payload")
+    ap.add_argument("--bundle", type=int, default=None, metavar="SEQ",
+                    help="render only the bundle with this seq")
+    ap.add_argument("--journal", type=int, default=20, metavar="N",
+                    help="journal entries shown per bundle "
+                         "(default 20)")
+    args = ap.parse_args(argv)
+    bundles = _load_bundles(args.path)
+    if args.bundle is not None:
+        bundles = [b for b in bundles if b.get("seq") == args.bundle]
+        if not bundles:
+            raise SystemExit(f"no bundle with seq {args.bundle}")
+    for b in bundles:
+        _render_bundle(b, args.journal, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
