@@ -159,6 +159,21 @@ def main(argv=None) -> int:
                          "to 'SLO at risk'). Requires --engine and "
                          "--slo (the board's targets steer the "
                          "tuner)")
+    ap.add_argument("--pool", nargs="?", const=0, type=int,
+                    default=None, metavar="N",
+                    help="shard the --engine across the local device "
+                         "mesh (cess_tpu/serve/pool.py): a DevicePool "
+                         "routes op-class batches over per-device "
+                         "worker lanes — deterministic least-loaded "
+                         "placement, per-(backend, device) breakers "
+                         "(with --resilience: one sick chip drains to "
+                         "its siblings before degrading to CPU), "
+                         "per-lane program caches. N limits the lanes "
+                         "(bare --pool = all local devices). Per-lane "
+                         "gauges appear as cess_engine_device_* on "
+                         "GET /metrics and in cess_engineStats. "
+                         "Results stay bit-identical to the "
+                         "single-device engine. Requires --engine")
     ap.add_argument("--resilience", default="off",
                     choices=["off", "on"],
                     help="attach the resilience layer "
@@ -449,6 +464,7 @@ def _make_cli_engine(args, spec):
     # getattr defaults: embedders hand-build minimal Namespaces
     slo_spec = getattr(args, "slo", None)
     adaptive = getattr(args, "adaptive", False)
+    pool_spec = getattr(args, "pool", None)
     if args.engine == "off":
         if args.resilience != "off":
             raise SystemExit("--resilience requires --engine "
@@ -459,7 +475,12 @@ def _make_cli_engine(args, spec):
         if adaptive:
             raise SystemExit("--adaptive requires --engine (it tunes "
                              "the submission engine's batching)")
+        if pool_spec is not None:
+            raise SystemExit("--pool requires --engine (it shards the "
+                             "submission engine's dispatch)")
         return None
+    if pool_spec is not None and pool_spec < 0:
+        raise SystemExit("--pool takes a non-negative lane count")
     if adaptive and slo_spec is None:
         raise SystemExit("--adaptive requires --slo (without a board's "
                          "targets the knob tuner has nothing to steer "
@@ -477,9 +498,12 @@ def _make_cli_engine(args, spec):
 
         slo = SloBoard(parse_targets(slo_spec))
     k = max(spec.fragment_count - 1, 1)      # reference RS(k, 1) shape
+    # --pool = all local devices; --pool=N = the first N lanes
+    pool = None if pool_spec is None else (pool_spec or True)
     return make_engine(k, spec.fragment_count - k,
                        rs_backend=args.engine, resilience=resilience,
-                       slo=slo, adaptive=True if adaptive else None)
+                       slo=slo, adaptive=True if adaptive else None,
+                       pool=pool)
 
 
 def _data_dir(args, spec) -> "str | None":

@@ -61,10 +61,12 @@ _BAD_OUTCOMES = ("error", "timeout", "saturated", "shed", "closed")
 
 # span attrs stable across same-seed replays — the only attrs the
 # retention witness may include (latency_s / occupancy-style numbers
-# depend on host timing and batch composition)
+# depend on host timing and batch composition). "device" is the pool
+# lane index (serve/pool.py): placement is deterministic over a
+# deterministic offered sequence, so lane identity replays.
 _CANON_ATTRS = frozenset(("outcome", "cls", "op", "rows", "degraded",
                           "tenant", "reason", "scenario", "round",
-                          "error"))
+                          "error", "device"))
 
 
 def _pin_draw(seed: bytes, trace_id: int, root_span_id: int) -> float:

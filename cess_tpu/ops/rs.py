@@ -23,6 +23,7 @@ batched device kernels.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Callable
 
@@ -154,15 +155,35 @@ class _MatrixApply:
             return _pallas_apply(self._bmat_np, data)
         return _apply_bitmatrix(self._bmat, data)
 
-    def aot(self, shape, dtype=jnp.uint8):
+    def aot(self, shape, dtype=jnp.uint8, device=None):
         """AOT-compile this apply for one exact input shape: the
         tables/matrices are baked into the executable as constants
         (pre-staged) and calls skip the jit dispatch/tracing machinery
         entirely — the repair warm path (TPUCodec.warm_reconstruct).
-        Returns the compiled callable (data) -> result."""
+        ``device`` pins which device the executable is compiled and
+        staged for (None = the current default device); the compiled
+        program is bound to that one device. Returns the compiled
+        callable (data) -> result."""
         fn = jax.jit(self.__call__)
-        return fn.lower(
-            jax.ShapeDtypeStruct(tuple(shape), dtype)).compile()
+        with contextlib.nullcontext() if device is None \
+                else jax.default_device(device):
+            return fn.lower(
+                jax.ShapeDtypeStruct(tuple(shape), dtype)).compile()
+
+
+def _placement_device():
+    """The device a dispatch issued RIGHT NOW would land on: the
+    active ``jax.default_device`` scope's device (the pool's per-lane
+    placement, serve/engine.py ``_lane_placement``), or None when no
+    scope is active — JAX's backend default. This is the device
+    component of the AOT warm-program cache key: an executable is
+    bound to the device it was compiled for, so a warm hit compiled
+    under device 0's scope must never be dispatched inside device 3's
+    (the one-device-assumption bug this key component fixes)."""
+    try:
+        return jax.config.jax_default_device
+    except AttributeError:   # very old jax: no such config state
+        return None
 
 
 def default_strategy() -> Strategy:
@@ -227,7 +248,8 @@ class TPUCodec:
             self._cache[key] = _MatrixApply(mat, self.strategy)
         return self._cache[key]
 
-    def warm_reconstruct(self, present, missing=None, shape=None):
+    def warm_reconstruct(self, present, missing=None, shape=None,
+                         device=None):
         """Pre-compile + pre-stage the reconstruct program for ONE
         erasure pattern and exact survivor shape (the restoral-market
         warm path): the decode matrix is built AND baked into an AOT
@@ -235,7 +257,16 @@ class TPUCodec:
         and shape dispatches the compiled program directly — no jit
         cache lookup, no tracing, no first-call compile in the latency
         budget (bench.py fragment_repair_warm_p99_ms measures the
-        difference). Returns the compiled callable."""
+        difference).
+
+        ``device`` pins the device the executable is compiled for
+        (the device-pool path warms once per lane); None warms for
+        the CURRENT placement — the active jax.default_device scope,
+        else the backend default. The warm cache is keyed by that
+        placement too: a ``reconstruct`` only hits a warm program
+        compiled for the placement it is dispatching under, never an
+        executable bound to a different chip (tests/test_pool.py pins
+        the two-device case). Returns the compiled callable."""
         present = tuple(present)
         if missing is None:
             missing = tuple(i for i in range(self.k + self.m)
@@ -244,10 +275,11 @@ class TPUCodec:
         if shape is None:
             raise ValueError("warm_reconstruct needs the exact "
                              "survivor shape, e.g. (k, fragment_size)")
-        key = (present, missing, tuple(shape))
+        key = (present, missing, tuple(shape),
+               _placement_device() if device is None else device)
         if key not in self._warm:
             self._warm[key] = self._matrix_for(
-                "repair", present, missing).aot(shape)
+                "repair", present, missing).aot(shape, device=device)
         return self._warm[key]
 
     def reconstruct(self, survivors: jax.Array, present: tuple[int, ...],
@@ -265,7 +297,12 @@ class TPUCodec:
             missing = tuple(i for i in range(self.k + self.m) if i not in present)
         missing = tuple(missing)
         survivors = jnp.asarray(survivors, dtype=jnp.uint8)
-        warm = self._warm.get((present, missing, tuple(survivors.shape)))
+        # the warm key carries the CURRENT placement (see
+        # warm_reconstruct): under a pool lane's default_device scope
+        # only that lane's executable can hit
+        warm = self._warm.get((present, missing,
+                               tuple(survivors.shape),
+                               _placement_device()))
         if warm is not None:
             self.warm_hits += 1
             return warm(survivors)

@@ -217,3 +217,23 @@ def stream_entry(pipeline: StoragePipeline, mesh: Mesh, batch: int,
 
     return {"program": program, "put": put, "put_ids": put_ids}
 
+
+def pool_stream_entry(pipeline: StoragePipeline, devices, batch: int,
+                      pair_ids: bool = False):
+    """:func:`stream_entry` against a DevicePool's lane devices
+    (cess_tpu/serve/pool.py ``stream_entry`` delegates here): an
+    (n_lanes, 1) mesh over exactly the pool's devices in lane order,
+    so each staged batch fans its segment axis across every lane.
+    ``batch`` must be divisible by the lane count (the seg-axis
+    sharding constraint); byte axis stays 1 so any
+    ``blocks_per_fragment`` divides it. Tags remain bit-identical to
+    the single-device fused program — the topology-invariance
+    contract above."""
+    devices = list(devices)
+    if batch % len(devices) != 0:
+        raise ValueError(
+            f"stream batch {batch} not divisible by the pool's "
+            f"{len(devices)} lanes")
+    mesh = make_mesh(devices, seg=len(devices), byte=1)
+    return stream_entry(pipeline, mesh, batch, pair_ids)
+

@@ -56,8 +56,11 @@ class HealthMonitor:
         self.min_samples = min_samples
         self.probe_every = probe_every
         # journal identity: the engine names its monitors ("codec",
-        # "audit") at registration so breaker journal entries and
-        # incident bundles say WHICH breaker moved
+        # "audit") at registration — and the device pool names each
+        # lane's per-(backend, device) monitors ("codec.d0",
+        # "audit.d3", serve/pool.py) — so breaker journal entries and
+        # incident bundles say WHICH breaker moved, and a single sick
+        # chip's trips never alias its siblings' health
         self.name = ""
         self._mu = threading.Lock()
         self._outcomes: collections.deque = \

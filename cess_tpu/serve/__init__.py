@@ -18,11 +18,18 @@ batching knobs tuned from the live latency signal
 (AdaptiveBatchPolicy) and SLO-gated, deadline-aware admission
 (AdmissionController) over an obs.SloBoard — opt-in via
 ``make_engine(slo=..., adaptive=...)`` / ``node.cli --slo --adaptive``.
+
+pool.py is the multi-chip serving plane (ISSUE 10): a DevicePool
+routes the batcher's drained batches across per-device worker lanes
+(deterministic least-loaded placement, per-(backend, device)
+breakers, drain-to-sibling on lane failure) — opt-in via
+``make_engine(pool=...)`` / ``node.cli --pool[=N]``.
 """
 from .adaptive import AdaptiveBatchPolicy, AdmissionController
 from .engine import EngineFuture, SubmissionEngine, make_engine
 from .policy import (AdmissionPolicy, EngineClosed, EngineError,
                      EngineSaturated, EngineShed, EngineTimeout)
+from .pool import DevicePool
 from .stats import EngineStats, StreamStats
 from .stream import StreamingIngest
 
@@ -30,6 +37,7 @@ __all__ = [
     "AdaptiveBatchPolicy",
     "AdmissionController",
     "AdmissionPolicy",
+    "DevicePool",
     "EngineClosed",
     "EngineError",
     "EngineFuture",

@@ -212,7 +212,7 @@ class SubmissionEngine:
     def __init__(self, codec=None, audit=None,
                  policy: AdmissionPolicy | None = None,
                  resilience=None, tracer=None, slo=None, adaptive=None,
-                 admission=None):
+                 admission=None, pool=None):
         if codec is None and audit is None:
             raise ValueError("engine needs a codec and/or audit backend")
         self.codec = codec
@@ -266,6 +266,17 @@ class SubmissionEngine:
             # codec breaker for its degrade response (no resilience =
             # no breaker = shed-only admission)
             admission.bind(self)
+        # multi-chip serving plane (serve/pool.py, opt-in): a
+        # DevicePool routes drained batches across per-device worker
+        # lanes. None = the single-device dispatch path, byte-for-byte
+        # the PR-1 behavior (one attribute load + None check per
+        # drained batch). Bound after the per-backend monitors exist —
+        # bind() builds each lane's per-(backend, device) breakers
+        # from the same monitor factory, plus lane-pinned audit views.
+        self.pool = pool
+        self.stats.pool = pool
+        if pool is not None:
+            pool.bind(self)
         self._queues: dict[str, collections.deque[_Request]] = {
             c: collections.deque() for c in CLASSES}
         self._lock = threading.Lock()
@@ -502,6 +513,8 @@ class SubmissionEngine:
         reconstruct program with its decode matrix baked in."""
         self._need_codec()
         warm = getattr(self.codec, "warm_reconstruct", None)
+        pool = self.pool
+        lanes = pool.lanes if pool is not None else ()
         for present, missing in patterns:
             present, missing = tuple(present), tuple(missing)
             for b in buckets:
@@ -513,6 +526,23 @@ class SubmissionEngine:
                     ("repair", present, missing, n, bucket),
                     lambda p=present, mi=missing:
                         (lambda a: self.codec.reconstruct(a, p, mi)))
+                # pool path: pre-populate EVERY lane's slice of the
+                # cache under the device-component keys _op_repair
+                # will look up, and AOT-compile per lane device — a
+                # repair storm fans out across lanes without any lane
+                # paying compile/staging time (and a program warmed
+                # for device 0 is never handed a lane-3 batch)
+                for lane in lanes:
+                    if warm is not None:
+                        warm(present, missing,
+                             (bucket, len(present), n),
+                             device=lane.device)
+                    self.programs.get(
+                        self._key(("repair", present, missing, n,
+                                   bucket), False, lane),
+                        lambda p=present, mi=missing:
+                            (lambda a: self.codec.reconstruct(a, p,
+                                                              mi)))
 
     def attach_stream(self, stream_stats) -> None:
         """Register a streaming driver's StreamStats so its per-stage
@@ -596,6 +626,10 @@ class SubmissionEngine:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout)
+        if self.pool is not None:
+            # the batcher drained what it will drain; the lane workers
+            # finish their pending batches, then stop
+            self.pool.close(timeout)
         if self._thread.is_alive():
             with self._cond:
                 for cls, q in self._queues.items():
@@ -767,6 +801,20 @@ class SubmissionEngine:
                     slo.observe(bcls, lat, ok=False, tenant=tenant,
                                 rows=rows)
                 continue
+            pool = self.pool
+            if pool is not None:
+                # multi-chip path: hand the drained batch to the
+                # device-pool scheduler — the chosen lane's worker
+                # runs it and settles the in-flight count via
+                # _batch_done. One attribute load + None check is the
+                # whole cost of this seam on the single-device path.
+                try:
+                    pool.dispatch(batch)
+                except BaseException as e:
+                    _flight.note("engine", "escape", error=repr(e))
+                    self._batch_done()
+                    raise
+                continue
             try:
                 if batch:
                     try:
@@ -783,6 +831,14 @@ class SubmissionEngine:
                 with self._cond:
                     self._inflight -= 1
                     self._cond.notify_all()
+
+    def _batch_done(self) -> None:
+        """Settle one drained batch's in-flight count — the pool path's
+        lane workers call this once the batch's futures are resolved
+        (the inline path settles in _run's finally)."""
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
 
     def _knobs(self, cls: str) -> tuple[float, int, int]:
         """(max_delay, max_batch_requests, max_batch_rows) for this
@@ -961,15 +1017,29 @@ class SubmissionEngine:
             return contextlib.nullcontext()
         return annotation(f"cess:{op}")
 
-    def _run_batch(self, batch: list[_Request]) -> None:
+    def _run_batch(self, batch: list[_Request], lane=None,
+                   tried=None) -> bool:
+        """Run one coalesced batch. ``lane`` is None on the inline
+        single-device path; on the pool path it is the DeviceLane
+        whose worker is running this batch — breaker gating then uses
+        the lane's per-(backend, device) monitor, dispatch pins to the
+        lane's device, and a denied/failed lane DRAINS the batch to a
+        healthy sibling (``tried`` carries the lane indices that
+        already failed it). Returns True when the batch was handed
+        off that way — its futures are then the sibling's to settle."""
         cls = batch[0].cls
         op = batch[0].key[0]
         runner: Callable = getattr(self, f"_op_{op}")
         res = self.resilience
-        mon = self.monitors.get(self._BACKEND_OF.get(op))
-        # breaker open (and no probe due): serve on the CPU fallback
+        mons = self.monitors if lane is None else lane.monitors
+        mon = mons.get(self._BACKEND_OF.get(op))
+        # breaker open (and no probe due): drain to a healthy sibling
+        # lane when there is one, else serve on the CPU fallback
         degraded = res is not None and res.fallback \
             and mon is not None and not mon.allow()
+        if degraded and lane is not None and self.pool.requeue(
+                batch, lane, tried if tried is not None else set()):
+            return True
         if degraded:
             res.stats.note_degraded(cls)
         tracer = self._tracer_now()
@@ -991,20 +1061,38 @@ class SubmissionEngine:
             # active span for the dispatch, so fault-injection firings
             # (faults.inject below) annotate it via obs.event
             with self._device_annotation(tracer, op), \
+                    self._lane_placement(lane, degraded), \
                     (trace.NOOP_SPAN if tracer is None else tracer.start(
                         f"device.{op}", sys="device", parent=bspan,
                         current=True, op=op, degraded=degraded,
-                        backend="cpu-fallback" if degraded else "primary")):
+                        backend="cpu-fallback" if degraded else "primary",
+                        **({} if lane is None
+                           else {"device": lane.index}))):
                 if not degraded:
                     faults.inject("engine.dispatch")   # chaos seam
-                results, device_rows = runner(batch, degraded)
+                    if lane is not None:
+                        # per-lane seam: chaos plans kill ONE lane's
+                        # dispatch while its siblings stay healthy
+                        faults.inject(f"engine.dispatch.d{lane.index}")
+                # two-arg call off the pool path: the (batch, degraded)
+                # runner signature is a public monkeypatch seam
+                results, device_rows = (
+                    runner(batch, degraded) if lane is None
+                    else runner(batch, degraded, lane))
         except Exception as e:        # op failure
             if mon is not None and not degraded:
                 mon.record_error()
             bspan.set(error=repr(e)).finish()
+            if lane is not None and not degraded and self.pool.requeue(
+                    batch, lane, tried if tried is not None else set()):
+                # member isolation preserved: the batch moves WHOLE to
+                # a healthy sibling; salvage (solo re-runs / CPU
+                # degradation) only runs once every sibling failed it
+                return True
             if res is not None and self._salvage_batch(runner, batch, e,
-                                                       mon, degraded):
-                return
+                                                       mon, degraded,
+                                                       lane):
+                return False
             with self._lock:
                 self.stats.classes[cls].failed += len(batch)
             fail_t = time.monotonic()
@@ -1012,7 +1100,7 @@ class SubmissionEngine:
                 r.future._reject(e)
                 r.span.set(outcome="error", error=repr(e)).finish()
                 self._observe_failure(r, fail_t)
-            return
+            return False
         if mon is not None and not degraded:
             mon.record_success(time.monotonic() - t0)
         self._account_batch(batch, device_rows, bspan)
@@ -1021,6 +1109,7 @@ class SubmissionEngine:
             r.future._resolve(out)
             if r.span is not trace.NOOP_SPAN:
                 r.span.set(outcome="ok").finish()
+        return False
 
     def _observe_failure(self, r: _Request, now: float) -> None:
         """Feed one rejected request into the SLO windows (failures
@@ -1083,7 +1172,7 @@ class SubmissionEngine:
 
     def _salvage_batch(self, runner: Callable, batch: list[_Request],
                        primary_exc: BaseException, mon,
-                       degraded: bool) -> bool:
+                       degraded: bool, lane=None) -> bool:
         """A batch op failed with resilience configured: isolate the
         members — re-run each ALONE once (one poisoned request must
         not fail its batch-mates), then, if the device attempt failed
@@ -1109,9 +1198,15 @@ class SubmissionEngine:
             if solo:
                 r.span.event("salvage.solo")
                 try:
-                    if not degraded:
-                        faults.inject("engine.dispatch")
-                    out, rows = runner([r], degraded)
+                    with self._lane_placement(lane, degraded):
+                        if not degraded:
+                            faults.inject("engine.dispatch")
+                            if lane is not None:
+                                faults.inject(
+                                    f"engine.dispatch.d{lane.index}")
+                        out, rows = (runner([r], degraded)
+                                     if lane is None
+                                     else runner([r], degraded, lane))
                 except Exception as e:  # noqa: BLE001 — per-member isolation
                     exc = e
                     if mon is not None and not degraded:
@@ -1127,7 +1222,8 @@ class SubmissionEngine:
                                             sys="resilience",
                                             parent=r.span,
                                             current=True, cls=cls)):
-                        out, rows = runner([r], True)
+                        out, rows = (runner([r], True) if lane is None
+                                     else runner([r], True, lane))
                     res.stats.note_fallback(cls)
                 except Exception as e:  # noqa: BLE001 — fallback is best-effort
                     exc = e
@@ -1173,32 +1269,60 @@ class SubmissionEngine:
 
     def _rs_backend(self, degraded: bool):
         """The ErasureCodec serving this batch: the configured device
-        gate, or the CPU reference when the breaker degraded it."""
+        gate, or the CPU reference when the breaker degraded it. The
+        codec is shared across pool lanes — lane placement comes from
+        the _lane_placement default-device scope, not the gate."""
         return self._fallback_codec if degraded else self.codec
 
-    def _audit_backend(self, degraded: bool):
-        return self._fallback_audit if degraded else self.audit
+    def _audit_backend(self, degraded: bool, lane=None):
+        """The AuditBackend serving this batch. Unlike the codec, an
+        AuditBackend pins every op to ITS OWN device
+        (ops/audit_backend.py ``_on``), so the pool path must use the
+        lane's own view — the shared gate would collapse every audit
+        batch back onto one chip."""
+        if degraded:
+            return self._fallback_audit
+        if lane is not None and lane.audit is not None:
+            return lane.audit
+        return self.audit
 
     @staticmethod
-    def _key(key: tuple, degraded: bool) -> tuple:
+    def _lane_placement(lane, degraded: bool):
+        """Device scope for a batch dispatch: the lane's device on the
+        pool path, JAX's default placement otherwise (and always for
+        degraded batches — the CPU fallback gates pin themselves)."""
+        if lane is None or degraded:
+            return contextlib.nullcontext()
+        return jax.default_device(lane.device)
+
+    @staticmethod
+    def _key(key: tuple, degraded: bool, lane=None) -> tuple:
         """Degraded programs cache under their own keys — a breaker
         flip must never hand a device program a CPU batch or vice
-        versa."""
-        return key + ("cpu-fallback",) if degraded else key
+        versa. On the pool path the key grows a device component for
+        the same reason: a program compiled (AOT-warmed) for lane 0's
+        device must never be handed a batch placed on lane 3
+        (degraded keys stay device-free — the CPU fallback program is
+        one program, shared by every lane)."""
+        if degraded:
+            return key + ("cpu-fallback",)
+        if lane is not None:
+            return key + (("device", lane.index),)
+        return key
 
-    def _op_encode(self, batch, degraded=False):
+    def _op_encode(self, batch, degraded=False, lane=None):
         codec = self._rs_backend(degraded)
         data = _concat_rows([r.arrays["data"] for r in batch])
         total = data.shape[0]
         bucket = bucket_rows(total)
         _, k, n = data.shape
         prog = self.programs.get(self._key(("encode", k, n, bucket),
-                                           degraded),
+                                           degraded, lane),
                                  lambda: codec.encode)
         out = prog(_pad_axis0(data, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_repair(self, batch, degraded=False):
+    def _op_repair(self, batch, degraded=False, lane=None):
         codec = self._rs_backend(degraded)
         kind = batch[0].key[1]
         aux = batch[0].aux
@@ -1210,33 +1334,34 @@ class SubmissionEngine:
             present, missing = aux["present"], aux["missing"]
             prog = self.programs.get(
                 self._key(("repair", present, missing, n, bucket),
-                          degraded),
+                          degraded, lane),
                 lambda: (lambda a: codec.reconstruct(a, present,
                                                      missing)))
         else:
             present = aux["present"]
             prog = self.programs.get(
-                self._key(("decode", present, n, bucket), degraded),
+                self._key(("decode", present, n, bucket), degraded,
+                          lane),
                 lambda: (lambda a: codec.decode_data(a, present)))
         out = prog(_pad_axis0(surv, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_tag(self, batch, degraded=False):
-        audit = self._audit_backend(degraded)
+    def _op_tag(self, batch, degraded=False, lane=None):
+        audit = self._audit_backend(degraded, lane)
         ids = _concat_rows([r.arrays["ids"] for r in batch])
         frags = _concat_rows([r.arrays["fragments"] for r in batch])
         total = frags.shape[0]
         bucket = bucket_rows(total)
         nbytes = frags.shape[1]
         prog = self.programs.get(self._key(("tag", nbytes, bucket),
-                                           degraded),
+                                           degraded, lane),
                                  lambda: audit.tag_fragments)
         out = prog(_pad_axis0(ids, bucket),
                    _pad_axis0(frags, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_verify_batch(self, batch, degraded=False):
-        audit = self._audit_backend(degraded)
+    def _op_verify_batch(self, batch, degraded=False, lane=None):
+        audit = self._audit_backend(degraded, lane)
         aux = batch[0].aux
         ids = _concat_rows([r.arrays["ids"] for r in batch])
         mu = _concat_rows([r.arrays["mu"] for r in batch])
@@ -1245,7 +1370,8 @@ class SubmissionEngine:
         bucket = bucket_rows(total)
         num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
         prog = self.programs.get(
-            self._key(("verify_batch", batch[0].key, bucket), degraded),
+            self._key(("verify_batch", batch[0].key, bucket), degraded,
+                      lane),
             lambda: (lambda i, u, s: audit.verify_batch(
                 i, num_blocks, idx, nu, u, s)))
         out = prog(_pad_axis0(ids, bucket),
@@ -1253,7 +1379,7 @@ class SubmissionEngine:
                    _pad_axis0(sigma, bucket))[:total]
         return self._split_rows(batch, out), bucket
 
-    def _op_verify_agg(self, batch, degraded=False):
+    def _op_verify_agg(self, batch, degraded=False, lane=None):
         from ..ops import podr2
 
         aux = batch[0].aux
@@ -1270,7 +1396,7 @@ class SubmissionEngine:
             mu[i] = r.arrays["mu"]
             sigma[i] = r.arrays["sigma"]
         num_blocks, idx, nu = (aux["num_blocks"], aux["idx"], aux["nu"])
-        audit = self._audit_backend(degraded)
+        audit = self._audit_backend(degraded, lane)
 
         def build():
             fn = jax.vmap(lambda i, rr, u, s: podr2.verify_aggregate(
@@ -1282,13 +1408,14 @@ class SubmissionEngine:
             return run
 
         prog = self.programs.get(
-            self._key(("verify_agg", batch[0].key, fb, rb), degraded),
+            self._key(("verify_agg", batch[0].key, fb, rb), degraded,
+                      lane),
             build)
         out = np.asarray(prog(ids, rs, mu, sigma))
         results = [bool(out[i]) for i in range(len(batch))]
         return results, rb * fb
 
-    def _op_prove(self, batch, degraded=False):
+    def _op_prove(self, batch, degraded=False, lane=None):
         from ..ops import podr2
 
         aux = batch[0].aux
@@ -1304,7 +1431,7 @@ class SubmissionEngine:
             tags[i, :r.rows] = r.arrays["tags"]
             rs[i, :r.rows] = r.arrays["r"]
         idx, nu, sectors = aux["idx"], aux["nu"], aux["sectors"]
-        audit = self._audit_backend(degraded)
+        audit = self._audit_backend(degraded, lane)
 
         def build():
             fn = jax.vmap(lambda f, t, rr: podr2.prove_aggregate(
@@ -1316,7 +1443,8 @@ class SubmissionEngine:
             return run
 
         prog = self.programs.get(
-            self._key(("prove", batch[0].key, fb, rb), degraded), build)
+            self._key(("prove", batch[0].key, fb, rb), degraded, lane),
+            build)
         mu, sigma = prog(frags, tags, rs)
         mu = np.asarray(mu)
         sigma = np.asarray(sigma)
@@ -1329,7 +1457,7 @@ def make_engine(k: int | None = None, m: int | None = None, *,
                 podr2_key=None, audit_backend: str = "cpu",
                 policy: AdmissionPolicy | None = None,
                 resilience=None, tracer=None, slo=None, adaptive=None,
-                admission=None) -> SubmissionEngine:
+                admission=None, pool=None) -> SubmissionEngine:
     """Build an engine over the two trait gates.
 
     k/m select the ErasureCodec geometry (None = no codec: the engine
@@ -1349,6 +1477,10 @@ def make_engine(k: int | None = None, m: int | None = None, *,
     board's targets. admission: an AdmissionController; auto-built
     when both ``slo`` and ``adaptive`` are present (pass your own to
     customize the protect/shed classes, or ``False`` to disable).
+    pool: the multi-chip serving plane (serve/pool.py) — a built
+    DevicePool, or True (all local devices) / a device count N (the
+    ``--pool[=N]`` CLI form). None/0/False = the single-device
+    dispatch path, unchanged.
     """
     codec = None
     if k is not None:
@@ -1375,6 +1507,12 @@ def make_engine(k: int | None = None, m: int | None = None, *,
         from .adaptive import AdmissionController
 
         admission = AdmissionController(slo, adaptive)
+    if pool and not hasattr(pool, "bind"):
+        # True = every local device; an int = the first N of them
+        from .pool import DevicePool
+
+        pool = DevicePool(n=None if pool is True else int(pool))
     return SubmissionEngine(codec, audit, policy, resilience=resilience,
                             tracer=tracer, slo=slo, adaptive=adaptive,
-                            admission=admission or None)
+                            admission=admission or None,
+                            pool=pool or None)

@@ -1,0 +1,347 @@
+"""Multi-chip serving plane: the device-pool scheduler that shards the
+submission engine across the mesh.
+
+The data plane has scaled past one chip for a while —
+``parallel/mesh.py`` runs the fused encode+tag program over an
+8-device (seg, byte) mesh — but the serving plane was still a
+single-device service: every batch the engine drained dispatched to
+ONE device, so ``stream_encode_tag_GiBps`` and
+``podr2_100k_tag_verify_frags_per_s`` were per-chip ceilings, not
+fleet numbers. :class:`DevicePool` turns the engine into a fleet
+service:
+
+- each device gets a :class:`DeviceLane` — its own worker thread, its
+  own per-(backend, device) ``HealthMonitor`` breakers (named
+  ``codec.d<i>`` / ``audit.d<i>`` beside the engine's per-backend
+  ones), its own ``AuditBackend`` view pinned to the lane device, and
+  its own slice of the program cache (``SubmissionEngine._key`` grows
+  a ``("device", i)`` component on the pool path, so a program
+  compiled for device 0 is never handed a batch placed on device 3);
+- placement is deficit-weighted on in-flight device rows: the
+  least-loaded lane wins, ties break by device index — deterministic,
+  no wallclock, no entropy, the same discipline as the engine's
+  weighted-fair drain anchor. Every placement appends to a bounded
+  count-sequenced log, the replay witness (same offered sequence =>
+  same log);
+- a lane whose dispatch fails (or whose breaker denies admission)
+  DRAINS its batch to a healthy sibling instead of degrading: the
+  batch is requeued whole (member isolation preserved — the engine's
+  salvage machinery only runs once every sibling has been tried), so
+  one sick chip degrades to CPU only when the whole pool is sick.
+  While a lane's breaker is open, every ``probe_every``-th placement
+  for that op class is offered back to it as a recovery probe (its
+  own breaker decides whether to admit it) — without this, avoiding
+  open lanes would make every trip permanent;
+- ``StreamingIngest`` placement: :meth:`DevicePool.stream_entry`
+  builds the (program, put, put_ids) triple against the pool's
+  (n_lanes, 1) mesh, so each staged batch's sharded ``device_put``
+  fans segments across every lane in one transfer.
+
+Determinism contract: the pool changes WHERE a batch runs, never what
+it computes — the GF(2^8)/PoDR2 programs are platform- and
+topology-deterministic (tests/test_pool.py pins pool == single-device
+== direct, byte for byte). The zero-cost contract holds too: an
+engine built without ``pool=`` takes the exact PR-1 dispatch path
+(one attribute load + None check per drained batch).
+
+Thread-safety: every pool/lane counter is guarded by the one pool
+lock; breaker state lives in the monitors (their own locks). Flight
+journal notes (``pool.requeue`` / ``pool.escape``) always fire with
+the pool lock released — incident listeners snapshot the engine.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any
+
+import jax
+
+from ..obs import flight as _flight
+
+PLACEMENT_LOG = 4096     # bounded placement-log window (replay witness)
+
+
+class DeviceLane:
+    """One device's worker lane inside the pool: the device handle,
+    its per-(backend, device) breakers, its pinned AuditBackend view,
+    a pending-batch queue and the load/served counters placement reads.
+    All mutable fields are guarded by the owning pool's lock."""
+
+    __slots__ = ("index", "device", "audit", "monitors", "pending",
+                 "thread", "batches", "rows", "requeues",
+                 "inflight_rows")
+
+    def __init__(self, index: int, device):
+        self.index = index
+        self.device = device
+        self.audit = None                   # lane-pinned AuditBackend
+        self.monitors: dict[str, Any] = {}  # backend -> HealthMonitor
+        self.pending: collections.deque = collections.deque()
+        self.thread: threading.Thread | None = None
+        self.batches = 0          # batches this lane completed
+        self.rows = 0             # real rows across those batches
+        self.requeues = 0         # batches received from a sick sibling
+        self.inflight_rows = 0    # placement's deficit counter
+
+    def breaker_state(self, backend: str | None) -> str:
+        """This lane's breaker state for an op class's backend —
+        "closed" when unmonitored (no resilience configured)."""
+        mon = self.monitors.get(backend)
+        return "closed" if mon is None else mon.state
+
+
+class DevicePool:
+    """See module doc. Construct over explicit devices (or the first
+    ``n`` of ``jax.devices()``; ``n`` of 0/None means all), then pass
+    to ``make_engine(pool=...)`` — the engine binds the pool, which
+    builds the per-lane breakers and starts the lane workers."""
+
+    def __init__(self, devices=None, n: int | None = None,
+                 probe_every: int = 8):
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        if n:
+            devices = devices[:n]
+        if not devices:
+            raise ValueError("DevicePool needs at least one device")
+        if probe_every < 1:
+            raise ValueError("probe_every must be >= 1")
+        self.lanes = [DeviceLane(i, d) for i, d in enumerate(devices)]
+        self.probe_every = probe_every
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        self._engine = None
+        self._closed = False
+        self._seq = 0             # placement sequence (count, not time)
+        # the replay witness: (seq, op, members, rows, lane, reason)
+        self._log: collections.deque = \
+            collections.deque(maxlen=PLACEMENT_LOG)
+        self._probe_tick: dict[str, int] = {}   # op -> placements seen
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.lanes)
+
+    def devices(self) -> list:
+        return [lane.device for lane in self.lanes]
+
+    # -- engine binding ------------------------------------------------------
+    def bind(self, engine) -> None:
+        """Wire the pool into an engine (SubmissionEngine.__init__):
+        per-lane breakers from the engine's resilience monitor factory
+        (registered as ``<backend>.d<i>`` beside the engine's own), a
+        lane-pinned AuditBackend view per lane, and one worker thread
+        per lane. A pool serves exactly one engine."""
+        with self._mu:
+            if self._engine is not None:
+                raise ValueError("DevicePool already bound to an engine")
+            if self._closed:
+                raise ValueError("DevicePool is shut down")
+            self._engine = engine
+        res = engine.resilience
+        if res is not None:
+            for lane in self.lanes:
+                for backend in engine.monitors:
+                    mon = res.monitor()
+                    mon.name = f"{backend}.d{lane.index}"
+                    lane.monitors[backend] = mon
+                    res.stats.register_monitor(mon.name, mon)
+        if engine.audit is not None:
+            from ..ops.audit_backend import AuditBackend
+
+            for lane in self.lanes:
+                # same key, lane device: AuditBackend pins every op to
+                # its own device, so without a per-lane view all audit
+                # batches would collapse back onto one chip
+                lane.audit = AuditBackend(engine.audit.key, lane.device)
+        for lane in self.lanes:
+            lane.thread = threading.Thread(
+                target=self._worker, args=(lane,), daemon=True,
+                name=f"cess-pool-lane-{lane.index}")
+            lane.thread.start()
+
+    # -- placement -----------------------------------------------------------
+    def dispatch(self, batch) -> None:
+        """Place one drained batch on a lane (engine batcher thread).
+        The engine already counted it in-flight; the lane worker
+        settles it via ``engine._batch_done``."""
+        op = batch[0].key[0]
+        rows = sum(r.rows for r in batch)
+        with self._cond:
+            if self._closed or self._engine is None:
+                raise RuntimeError("device pool is not serving")
+            lane, reason = self._place_locked(op, rows, frozenset())
+            lane.inflight_rows += rows
+            self._seq += 1
+            self._log.append((self._seq, op, len(batch), rows,
+                              lane.index, reason))
+            lane.pending.append((batch, set()))
+            self._cond.notify_all()
+
+    def requeue(self, batch, lane: DeviceLane, tried: set) -> bool:
+        """Drain a failing lane's in-flight batch to a healthy sibling
+        (engine._run_batch's pool path, on dispatch failure or breaker
+        denial). ``tried`` accumulates the lane indices that already
+        failed this batch so it can never bounce forever. Returns False
+        when no healthy untried sibling exists — the caller falls back
+        to the engine's salvage/degrade machinery."""
+        eng = self._engine
+        tried.add(lane.index)
+        op = batch[0].key[0]
+        rows = sum(r.rows for r in batch)
+        backend = eng._BACKEND_OF.get(op) if eng is not None else None
+        with self._cond:
+            if self._closed:
+                return False
+            sibs = [ln for ln in self.lanes
+                    if ln.index not in tried
+                    and ln.breaker_state(backend) == "closed"]
+            if not sibs:
+                return False
+            target = self._least_loaded(sibs)
+            target.inflight_rows += rows
+            target.requeues += 1
+            self._seq += 1
+            self._log.append((self._seq, op, len(batch), rows,
+                              target.index, "requeue"))
+            target.pending.append((batch, tried))
+            self._cond.notify_all()
+        # journal with the pool lock released (incident listeners read
+        # engine/pool snapshots): the drain is exactly the black-box
+        # moment a postmortem wants on the timeline
+        _flight.note("pool", "requeue", op=op, rows=rows,
+                     src=lane.index, dst=target.index)
+        return True
+
+    def _place_locked(self, op: str, rows: int, tried) -> tuple:
+        """Pick the lane for a fresh placement (pool lock held).
+        Deficit-weighted on in-flight device rows: least-loaded wins,
+        ties by device index — no wallclock, no entropy. Lanes whose
+        breaker for the op's backend is open are avoided, except that
+        every ``probe_every``-th placement per op class is offered to
+        the least-loaded open lane as a recovery probe (its breaker
+        decides whether to admit); held lanes (SLO vacate) are never
+        probed. With every breaker open the least-loaded open lane is
+        picked anyway — its denial path degrades to CPU."""
+        eng = self._engine
+        backend = eng._BACKEND_OF.get(op) if eng is not None else None
+        lanes = [ln for ln in self.lanes if ln.index not in tried]
+        healthy = [ln for ln in lanes
+                   if ln.breaker_state(backend) == "closed"]
+        tripped = [ln for ln in lanes
+                   if ln.breaker_state(backend) == "open"]
+        if healthy and tripped:
+            tick = self._probe_tick.get(op, 0) + 1
+            self._probe_tick[op] = tick
+            if tick % self.probe_every == 0:
+                return self._least_loaded(tripped), "probe"
+        if healthy:
+            return self._least_loaded(healthy), "least-loaded"
+        return self._least_loaded(lanes), "all-open"
+
+    @staticmethod
+    def _least_loaded(lanes: list) -> DeviceLane:
+        return min(lanes, key=lambda ln: (ln.inflight_rows, ln.index))
+
+    # -- lane workers --------------------------------------------------------
+    def _worker(self, lane: DeviceLane) -> None:
+        while True:
+            with self._cond:
+                while not lane.pending and not self._closed:
+                    self._cond.wait()
+                if not lane.pending:
+                    return            # closed and drained
+                batch, tried = lane.pending.popleft()
+            rows = sum(r.rows for r in batch)
+            handed_off = False
+            try:
+                # the engine's batch runner does everything — breaker
+                # gating, device placement, salvage, future resolution.
+                # A truthy return means the batch was requeued to a
+                # sibling: it is no longer this lane's (or, for
+                # engine accounting, this dispatch's) responsibility.
+                handed_off = bool(self._engine._run_batch(
+                    batch, lane=lane, tried=tried))
+            except BaseException as e:
+                # an escape would kill this lane's worker — journal the
+                # black-box moment first (same contract as the engine
+                # batcher's escape note)
+                _flight.note("pool", "escape", lane=lane.index,
+                             error=repr(e))
+                raise
+            finally:
+                with self._cond:
+                    lane.inflight_rows -= rows
+                    if not handed_off:
+                        lane.batches += 1
+                        lane.rows += rows
+                if not handed_off:
+                    self._engine._batch_done()
+
+    # -- StreamingIngest placement -------------------------------------------
+    def stream_entry(self, pipeline, batch: int,
+                     pair_ids: bool = False) -> dict:
+        """The (program, put, put_ids) kwargs that point a
+        StreamingIngest at this pool's mesh: each staged batch's
+        sharded ``device_put`` fans the segment axis across every
+        lane in one transfer (parallel/mesh.py pool_stream_entry).
+        ``batch`` must be divisible by the lane count."""
+        from ..parallel.mesh import pool_stream_entry
+
+        return pool_stream_entry(pipeline, self.devices(), batch,
+                                 pair_ids)
+
+    # -- introspection / lifecycle -------------------------------------------
+    def placement_log(self) -> tuple:
+        """The bounded placement log — ``(seq, op, members, rows,
+        lane, reason)`` rows, count-sequenced. Same seed + same offered
+        sequence reproduces it row for row (tests/test_pool.py)."""
+        with self._mu:
+            return tuple(self._log)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            lanes = []
+            for lane in self.lanes:
+                lanes.append({
+                    "device": lane.index,
+                    "platform": getattr(lane.device, "platform", "?"),
+                    "batches": lane.batches,
+                    "rows": lane.rows,
+                    "requeues": lane.requeues,
+                    "inflight_rows": lane.inflight_rows,
+                    "breakers": {b: m.state
+                                 for b, m in lane.monitors.items()},
+                })
+            return {"n_devices": len(self.lanes),
+                    "placements": self._seq,
+                    "lanes": lanes}
+
+    def metrics(self) -> dict[str, float]:
+        """Flat per-device gauges for the ``/metrics`` exposition —
+        the ``cess_engine_device_*`` family (merged by
+        EngineStats.metrics)."""
+        snap = self.snapshot()
+        out = {"cess_engine_device_count": float(snap["n_devices"]),
+               "cess_engine_device_placements": float(snap["placements"])}
+        for lane in snap["lanes"]:
+            i = lane["device"]
+            for name in ("batches", "rows", "requeues", "inflight_rows"):
+                out[f"cess_engine_device_{i}_{name}"] = float(lane[name])
+            for backend, state in lane["breakers"].items():
+                out[f"cess_engine_device_{i}_{backend}_open"] = \
+                    0.0 if state == "closed" else 1.0
+        return out
+
+    def close(self, timeout: float | None = 5.0) -> None:
+        """Stop the lane workers after they drain their pending
+        batches (SubmissionEngine.close calls this)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        for lane in self.lanes:
+            t = lane.thread
+            if t is not None:
+                t.join(timeout)

@@ -140,6 +140,11 @@ class EngineStats:
         # labeled_series()/labeled_histograms(), not these flat dicts
         self.slo = None
         self.adaptive = None
+        # DevicePool (serve/pool.py) when the engine serves the
+        # multi-chip plane — duck-typed (snapshot()/metrics()) like
+        # the attachments above; exports the cess_engine_device_*
+        # per-lane family
+        self.pool = None
 
     def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
         """JSON-shaped dump for the RPC debug endpoint."""
@@ -170,6 +175,8 @@ class EngineStats:
             out["slo"] = self.slo.snapshot()
         if self.adaptive is not None:
             out["adaptive"] = self.adaptive.snapshot()
+        if self.pool is not None:
+            out["devices"] = self.pool.snapshot()
         return out
 
     def metrics(self, queue_depths: dict[str, int] | None = None
@@ -197,6 +204,10 @@ class EngineStats:
         if self.adaptive is not None:
             # cess_adaptive_* per-class knob/estimate gauges (ISSUE 6)
             out.update(self.adaptive.metrics())
+        if self.pool is not None:
+            # cess_engine_device_* per-lane placement/load/breaker
+            # gauges (the multi-chip serving plane, serve/pool.py)
+            out.update(self.pool.metrics())
         return out
 
     def histograms(self) -> dict[str, prom.Histogram]:

@@ -111,6 +111,13 @@ class StreamingIngest:
               shard_map'd step here.
     put / put_ids: override staging (default jax.device_put) — the
               mesh entry passes sharded placements.
+    pool:     optional DevicePool (serve/pool.py) — device-aware
+              placement: the driver derives its (program, put,
+              put_ids) from ``pool.stream_entry``'s mesh over the
+              pool's lanes, so each staged batch's sharded
+              ``device_put`` fans the segment axis across every lane
+              in one transfer. Explicit program/put overrides win;
+              ``batch`` must be divisible by the lane count.
     engine:   optional SubmissionEngine to export stats through.
     tenant:   optional per-tenant accounting tag (obs/slo.py): with an
               attached engine carrying an SLO board, each staged batch
@@ -121,10 +128,17 @@ class StreamingIngest:
 
     def __init__(self, pipeline, batch: int, *, depth: int = 2,
                  program=None, put=None, put_ids=None, stats=None,
-                 engine=None, tenant: str | None = None):
+                 engine=None, tenant: str | None = None, pool=None):
         if batch < 1 or depth < 1:
             raise ValueError(f"bad stream shape: batch={batch}, "
                              f"depth={depth}")
+        if pool is not None and program is None:
+            # device-aware placement: shard the staged batches over
+            # the pool's lanes (the single-device default otherwise)
+            entry = pool.stream_entry(pipeline, batch)
+            program = entry["program"]
+            put = put or entry["put"]
+            put_ids = put_ids or entry["put_ids"]
         self.pipeline = pipeline
         self.batch = batch
         self.depth = depth

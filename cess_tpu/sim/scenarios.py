@@ -54,6 +54,11 @@ class Scenario:
     - ``slo``: ``(cls, p99_s)`` targets for the round's board.
     - ``checks`` run after EVERY round; ``final_checks`` once at the
       end (convergence properties that only hold after healing).
+    - ``pool``: route the world's gateway encodes/tags through a
+      device-pool submission engine (serve/pool.py) for the run, so
+      chaos campaigns exercise the real multi-lane serving plane;
+      the pool snapshot rides :attr:`SimReport.pool` and lane
+      breaker trips land in the armed flight recorder's journal.
     """
 
     name: str
@@ -64,6 +69,7 @@ class Scenario:
     slo: tuple = (("round", 4.0), ("upload", 4.0))
     checks: tuple = ("finalized-prefix", "vote-locks")
     final_checks: tuple = ()
+    pool: bool = False
 
 
 def resolve_ref(world: World, ref: str) -> int:
@@ -123,6 +129,12 @@ class SimReport:
     # separate from the four run streams below
     recorder: "_flight.FlightRecorder | None" = None
     reporter: "IncidentReporter | None" = None
+    # the device-pool serving plane (ISSUE 10): the pool's end-of-run
+    # snapshot when the scenario ran ``pool=True`` — informational
+    # (per-lane batch/requeue counters and breaker states), NOT part
+    # of the witness: lane timing is wall-clock, outputs are
+    # bit-identical to the single-device engine by construction
+    pool: "dict | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -228,6 +240,20 @@ def _apply_action(world: World, pending: dict, rnd: int,
         raise ValueError(f"unknown scenario action {action!r}")
 
 
+def _pool_engine(world: World):
+    """A device-pool submission engine matched to the world's storage
+    pipeline: same RS geometry, same PoDR2 key (a mismatched key would
+    tag with different secrets than the direct path), all visible
+    devices, breakers enabled so lane faults trip and drain."""
+    from ..resilience import ResilienceConfig
+    from ..serve import make_engine
+
+    pipe = world.pipeline
+    return make_engine(pipe.config.k, pipe.config.m, rs_backend="jax",
+                       podr2_key=pipe.podr2_key,
+                       resilience=ResilienceConfig(), pool=True)
+
+
 def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                  tracer=None, strict: bool = True,
                  flight=None) -> SimReport:
@@ -244,6 +270,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     as pin objectives."""
     seed_b = seed if isinstance(seed, bytes) else str(seed).encode()
     world = _build_world(scenario, seed_b, n_nodes)
+    pool_snap: dict = {}
     # tiny windows: scenario rounds produce a handful of observations
     # per class, and the transition log must be able to flip on them
     board = SloBoard(tuple(SloTarget(cls, p99_s=p99)
@@ -268,6 +295,21 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 tracer.attach_flight(recorder)
                 stack.callback(tracer.attach_flight, None)
             stack.enter_context(_flight.armed(recorder))
+            if scenario.pool:
+                # route the storage pipeline through a device-pool
+                # engine for the run: gateway encode/tag submits place
+                # across mesh lanes, faulted lanes drain to siblings,
+                # and every breaker trip is journaled by the armed
+                # recorder. Submits are synchronous from the single
+                # sim thread, so placement (and the fault plan's
+                # per-site ordinals) replay deterministically; the
+                # snapshot is captured before the engine closes.
+                eng = _pool_engine(world)
+                stack.callback(eng.close)
+                stack.callback(lambda: pool_snap.update(
+                    eng.pool.snapshot()))
+                stack.callback(setattr, world.pipeline, "engine", None)
+                world.pipeline.engine = eng
             # each bundle embeds the scenario identity + the live
             # witness streams — everything a replay needs
             reporter = IncidentReporter(
@@ -312,7 +354,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     return SimReport(scenario=scenario.name, seed=seed_b, world=world,
                      board=board, plan=plan, rounds_run=scenario.rounds,
                      uploads_active=active, recorder=recorder,
-                     reporter=reporter)
+                     reporter=reporter, pool=pool_snap or None)
 
 
 # -- the library --------------------------------------------------------------
@@ -376,6 +418,25 @@ SCENARIOS: dict[str, Scenario] = {
             (3, "upload", 0, "alice", 20_000, 2),
             (6, "upload", 1, "alice", 20_000),
         ),
+        slo=(("round", 4.0), ("upload", 2.0)),
+        checks=("finalized-prefix", "vote-locks"),
+        final_checks=("storage-convergence",),
+    ),
+    # the hotspot again, served by the REAL multi-lane plane (ISSUE
+    # 10): gateway encodes/tags route through a device-pool engine
+    # while a seeded fault kills every dispatch on lane 0 — the lane's
+    # breakers trip, work drains to siblings, uploads still activate
+    # and storage still converges; the pool snapshot rides the report
+    "gateway_hotspot_pool": Scenario(
+        name="gateway_hotspot_pool", rounds=14, pool=True,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 4), ("n_gateways", 2)))),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000, 2),
+            (3, "upload", 0, "alice", 20_000, 2),
+            (6, "upload", 1, "alice", 20_000),
+        ),
+        faults=(("engine.dispatch.d0", 1.0, "raise"),),
         slo=(("round", 4.0), ("upload", 2.0)),
         checks=("finalized-prefix", "vote-locks"),
         final_checks=("storage-convergence",),

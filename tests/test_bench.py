@@ -27,6 +27,8 @@ EXPECTED = (
     "adaptive_mixed_p99_ms",
     "sim_500node_round_drain_s",
     "rs_4p8_encode_GiBps_per_chip",
+    "pool_stream_encode_tag_GiBps",
+    "pool_podr2_tag_verify_frags_per_s",
 )
 
 
@@ -97,6 +99,23 @@ def test_bench_smoke_every_metric_finite():
     sim = got["sim_500node_round_drain_s"]
     assert sim["events"] >= 1 and sim["events_per_s"] > 0
     assert sim["virtual_s"] > 0 and sim["n_nodes"] >= 2
+    # the pool metrics (ISSUE 10): multi-lane runs on >=2 (virtual)
+    # devices, asserted bit-identical to the single-device engine
+    # in-bench, with the scaling ratio recorded honestly (CPU lanes
+    # share cores, so no threshold here — the >=0.8x claim rides the
+    # MULTICHIP dry-run on real chips)
+    for name in ("pool_stream_encode_tag_GiBps",
+                 "pool_podr2_tag_verify_frags_per_s"):
+        pool = got[name]
+        assert pool["n_devices"] >= 2, name
+        assert pool["bit_identical"] is True, name
+        assert math.isfinite(pool["scaling_efficiency"]) \
+            and pool["scaling_efficiency"] > 0, name
+    assert got["pool_podr2_tag_verify_frags_per_s"]["lanes_used"] >= 2
+    # EVERY record carries n_devices so tools/bench_diff.py can refuse
+    # to cross-compare a per-chip row against a pool row
+    for r in recs:
+        assert "n_devices" in r, r["metric"]
 
 
 # -- tools/bench_diff.py: the perf-trajectory regression gate ---------------
@@ -186,6 +205,49 @@ class TestBenchDiff:
         # the oldest round has nothing earlier to diff against
         assert bench_diff.main(
             [str(tmp_path / "BENCH_r02.json")]) == 2
+
+    def test_topology_change_is_a_note_not_a_regression(self, tmp_path):
+        # ISSUE 10 satellite: when n_devices differs between rounds
+        # the row becomes a note — a per-chip number vs a pool number
+        # is a topology change, not a perf regression, even when the
+        # raw value halves
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import bench_diff
+        finally:
+            sys.path.pop(0)
+        prev = tmp_path / "prev.jsonl"
+        curr = tmp_path / "curr.jsonl"
+        prev.write_text(json.dumps(
+            {"metric": "pool_stream_encode_tag_GiBps", "value": 8.0,
+             "n_devices": 1}) + "\n")
+        curr.write_text(json.dumps(
+            {"metric": "pool_stream_encode_tag_GiBps", "value": 4.0,
+             "n_devices": 2}) + "\n")
+        vals, devs = bench_diff.load_record(str(curr))
+        assert devs == {"pool_stream_encode_tag_GiBps": 2}
+        code, out, _ = _bench_diff(str(curr), "--against", str(prev),
+                                   "--json")
+        assert code == 0, out
+        rep = json.loads(out)
+        assert rep["regressions"] == []
+        row = rep["rows"][0]
+        assert row["delta_pct"] is None
+        assert row["regression_pct"] == 0.0
+        assert row["note"] == "n_devices changed (1 -> 2); not comparable"
+        # same topology on both sides: the normal gate still fires
+        curr.write_text(json.dumps(
+            {"metric": "pool_stream_encode_tag_GiBps", "value": 4.0,
+             "n_devices": 1}) + "\n")
+        code, out, _ = _bench_diff(str(curr), "--against", str(prev))
+        assert code == 1 and "REGRESSION" in out
+        # records without n_devices (pre-r10 fixtures) compare normally
+        prev.write_text(json.dumps(
+            {"metric": "x_GiBps", "value": 8.0}) + "\n")
+        curr.write_text(json.dumps(
+            {"metric": "x_GiBps", "value": 9.0}) + "\n")
+        code, out, _ = _bench_diff(str(curr), "--against", str(prev))
+        assert code == 0, out
 
     def test_missing_previous_round_is_a_usage_error(self):
         code, _, err = _bench_diff(self.CURR, "--against",

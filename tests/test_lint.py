@@ -404,6 +404,29 @@ class TestLockDiscipline:
         baseline = analysis.load_baseline(BASELINE)
         assert baseline == {}
 
+    def test_device_pool_layer_is_clean(self):
+        """ISSUE 10 satellite: the device-pool scheduler
+        (serve/pool.py — per-lane worker threads draining a shared
+        deque under the pool lock, breaker state consulted from the
+        submitter thread, flight-journal notes emitted outside the
+        lock) passes the trace-safety, lock-discipline and
+        span-balance families with zero findings and zero
+        suppressions; the baseline stays empty."""
+        path = os.path.join(REPO, "cess_tpu", "serve", "pool.py")
+        r = analysis.lint_paths([path], root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        # every family really applies at that path (dirty fixtures
+        # fire there), so the clean scan above is meaningful
+        assert "lock-unguarded-write" in rules_at(
+            lint(DIRTY_LOCK, "cess_tpu/serve/pool.py"))
+        assert "trace-print" in rules_at(
+            lint(DIRTY_TRACE, "cess_tpu/serve/pool.py"))
+        assert "span-balance" in rules_at(
+            lint(DIRTY_SPAN, "cess_tpu/serve/pool.py"))
+        assert analysis.load_baseline(BASELINE) == {}
+
 
 # ---------------------------------------------------------------------------
 # span balance (tracing discipline, ISSUE 5)

@@ -211,6 +211,24 @@ def _assert_scenario_behavior(name, report):
         # the transition log (the replay witness) recorded it
         assert any(cls == "upload" and to != "ok"
                    for cls, _frm, to, _n in report.board.transition_log())
+    elif name == "gateway_hotspot_pool":
+        # ISSUE 10: the run was really served by the device pool —
+        # the snapshot rides the report, lane 0 (every dispatch
+        # faulted by the seeded plan) completed NOTHING and its
+        # breakers tripped, the drained work landed on siblings, and
+        # the storage layer still converged (checked in-run)
+        snap = report.pool
+        assert snap is not None and snap["n_devices"] >= 2
+        lanes = {l["device"]: l for l in snap["lanes"]}
+        assert lanes[0]["batches"] == 0
+        assert sum(l["batches"] for l in snap["lanes"]) >= 1
+        assert sum(l["requeues"] for l in snap["lanes"]) >= 1
+        assert "open" in lanes[0]["breakers"].values()
+        # the lane trips were journaled for the flight recorder
+        trips = [e for e in report.recorder.journal_tail("breaker")
+                 if e["kind"] == "trip"
+                 and e["detail"]["name"].endswith(".d0")]
+        assert trips, "no lane-0 breaker trip in the flight journal"
     elif name == "adversarial_audit":
         adversarial = {f"m{j}"
                        for j in report.world.storage.adversarial_miners}

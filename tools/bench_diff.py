@@ -37,8 +37,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_record(path: str) -> dict[str, float]:
-    """{metric: value} from a round wrapper or raw JSONL file."""
+def load_record(path: str) -> tuple[dict[str, float], dict[str, int]]:
+    """({metric: value}, {metric: n_devices}) from a round wrapper or
+    raw JSONL file. The device map only holds metrics whose record
+    carries ``n_devices`` (every bench.py row since r10) — it lets
+    :func:`diff` refuse to cross-compare a per-chip row against a
+    multi-device pool row."""
     with open(path) as f:
         text = f.read()
     lines = text
@@ -49,6 +53,7 @@ def load_record(path: str) -> dict[str, float]:
     except ValueError:
         pass             # raw JSONL: parse line by line below
     out: dict[str, float] = {}
+    devs: dict[str, int] = {}
     for line in lines.splitlines():
         line = line.strip()
         if not line.startswith("{"):
@@ -59,9 +64,11 @@ def load_record(path: str) -> dict[str, float]:
             continue
         if isinstance(d, dict) and "metric" in d and "value" in d:
             out[d["metric"]] = float(d["value"])
+            if "n_devices" in d:
+                devs[d["metric"]] = int(d["n_devices"])
     if not out:
         raise ValueError(f"no metric lines found in {path}")
-    return out
+    return out, devs
 
 
 def round_of(path: str) -> int:
@@ -86,10 +93,17 @@ def lower_is_better(metric: str) -> bool:
 
 
 def diff(prev: dict[str, float], cur: dict[str, float],
-         threshold_pct: float) -> dict:
+         threshold_pct: float,
+         prev_devices: dict[str, int] | None = None,
+         cur_devices: dict[str, int] | None = None) -> dict:
     """Per-metric deltas + the regression verdict. ``delta_pct`` is
     signed raw change; ``regression_pct`` is how much the metric moved
-    in its BAD direction (0.0 when it improved)."""
+    in its BAD direction (0.0 when it improved). When BOTH sides carry
+    ``n_devices`` for a metric and the counts differ, the row becomes
+    a note (never a gate failure): a per-chip number vs a pool number
+    is a topology change, not a perf trajectory."""
+    prev_devices = prev_devices or {}
+    cur_devices = cur_devices or {}
     rows = []
     for metric in sorted(set(prev) | set(cur)):
         if metric not in prev or metric not in cur:
@@ -100,6 +114,15 @@ def diff(prev: dict[str, float], cur: dict[str, float],
                          "note": "only in "
                                  + ("current" if metric in cur
                                     else "previous")})
+            continue
+        pd = prev_devices.get(metric)
+        cd = cur_devices.get(metric)
+        if pd is not None and cd is not None and pd != cd:
+            rows.append({"metric": metric,
+                         "prev": prev[metric], "cur": cur[metric],
+                         "delta_pct": None, "regression_pct": 0.0,
+                         "note": f"n_devices changed "
+                                 f"({pd} -> {cd}); not comparable"})
             continue
         p, c = prev[metric], cur[metric]
         delta = 100.0 * (c - p) / p if p else 0.0
@@ -153,13 +176,13 @@ def main(argv=None) -> int:
             return 2
         against = earlier[0]     # newest-first => the next-lower round
     try:
-        prev = load_record(against)
-        cur = load_record(current)
+        prev, prev_devs = load_record(against)
+        cur, cur_devs = load_record(current)
     except (OSError, ValueError) as e:
         print(f"bench_diff: {e}", file=sys.stderr)
         return 2
 
-    report = diff(prev, cur, args.threshold)
+    report = diff(prev, cur, args.threshold, prev_devs, cur_devs)
     report["current"] = os.path.basename(current)
     report["against"] = os.path.basename(against)
     if args.json:
