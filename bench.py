@@ -720,6 +720,66 @@ def bench_sim(n_nodes: int, rounds_warm: int = 2):
     }
 
 
+def bench_fleet(n_nodes: int, rounds: int = 5):
+    """fleet_federate_100nodes_ms: wall ms for ONE fleet scrape round
+    at ``n_nodes`` — parse every node's Prometheus exposition, clamp
+    counters, merge labeled histograms, feed the global SLO board and
+    run a straggler scan (cess_tpu/obs/fleet). The expositions are
+    synthesized deterministically (no node stack in the loop), so the
+    number is the marginal cost of federation itself — the quantity
+    that decides how often a fleet-level scraper can afford to close a
+    round. One warm round runs outside the timed window (dict/window
+    allocation is a one-time cost)."""
+    from cess_tpu.obs.fleet import FleetPlane
+
+    def exposition(i: int, rnd: int) -> str:
+        # deterministic per-(node, round) content shaped like a real
+        # node/metrics.py render: gauges, counters and one histogram
+        h = (i * 2654435761 + rnd * 40503) & 0xFFFF
+        lines = [
+            "# TYPE cess_block_height gauge",
+            f"cess_block_height {rnd * 10 + (h % 7)}",
+            "# TYPE cess_gossip_frames_total counter",
+            f"cess_gossip_frames_total {rnd * 50 + (h % 100)}",
+            "# TYPE cess_upload_seconds histogram",
+            f'cess_upload_seconds_bucket{{le="0.5"}} {rnd * 2}',
+            f'cess_upload_seconds_bucket{{le="2"}} {rnd * 3}',
+            f'cess_upload_seconds_bucket{{le="+Inf"}} {rnd * 3 + 1}',
+            f"cess_upload_seconds_sum {round(rnd * 1.25, 3)}",
+            f"cess_upload_seconds_count {rnd * 3 + 1}",
+        ]
+        return "\n".join(lines) + "\n"
+
+    states = ("ok", "ok", "ok", "warn")
+
+    def one_round(plane, rnd):
+        for i in range(n_nodes):
+            inst = f"n{i:03d}"
+            plane.ingest(inst, exposition=exposition(i, rnd),
+                         slo={"targets": {"upload": {
+                             "state": states[(i + rnd) % len(states)]}}})
+            plane.stragglers.observe(inst, "lag",
+                                     float((i * 7 + rnd) % 5))
+        plane.seal_round()
+
+    plane = FleetPlane("bench", latency_families={
+        "upload": "cess_upload_seconds"}, min_nodes=4)
+    one_round(plane, 0)                    # warm
+    t0 = time.perf_counter()
+    for rnd in range(1, rounds + 1):
+        one_round(plane, rnd)
+    wall_ms = (time.perf_counter() - t0) * 1e3 / rounds
+    snap = plane.snapshot()
+    return wall_ms, {
+        "n_nodes": n_nodes,
+        "rounds": rounds,
+        "counters": len(snap["federation"]["counters"]),
+        "gauges": len(snap["federation"]["gauges"]),
+        "histograms": len(snap["federation"]["histograms"]),
+        "transitions": len(snap["board"]["transitions"]),
+    }
+
+
 def main() -> None:
     global _ASSERT_FINITE
 
@@ -737,10 +797,11 @@ def main() -> None:
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
                          "pool,stream,degraded,traceov,adaptive,"
-                         "encode,sim")
+                         "encode,sim,fleet")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "pool", "stream",
-             "degraded", "traceov", "adaptive", "encode", "sim"}
+             "degraded", "traceov", "adaptive", "encode", "sim",
+             "fleet"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -1022,6 +1083,22 @@ def main() -> None:
                     "crashed and a 2-way stripe partition; world "
                     "built + warmed outside the timed window; lower "
                     "is better")
+
+    if "fleet" in which:
+        # host-only python like the sim metric: the same 100-node
+        # shape runs under --smoke so the gate exercises the exact
+        # federation path the fleet plane uses live (ISSUE 12)
+        wall_ms, extra = bench_fleet(100)
+        # vs_baseline: against one 6 s block interval — how many
+        # times per block a fleet scraper could afford to close a
+        # 100-node round
+        emit("fleet_federate_100nodes_ms", wall_ms, "ms",
+             BLOCK_MS / wall_ms, **extra,
+             method="wall ms to close one fleet scrape round over 100 "
+                    "synthesized node expositions (parse + counter "
+                    "clamp + histogram merge + global SLO board + "
+                    "straggler scan, cess_tpu/obs/fleet); expositions "
+                    "built outside the timed window; lower is better")
 
     if "encode" in which:
         emit("rs_4p8_encode_GiBps_per_chip", encode_gibps, "GiB/s",

@@ -15,10 +15,12 @@ would flake instead of fail. These rules make the contract static:
                   the world seed (the ``_u64`` idiom)
 
 The family also covers the flight recorder's retention-decision code
-(obs/flight.py + obs/incident.py, ISSUE 9): "same seed retains the
-same traces and bundles the same incidents" is the identical replay
-contract, so a wall-clock read or entropy draw in a pin decision is
-the same class of bug as one in a sim world.
+(obs/flight.py + obs/incident.py, ISSUE 9) and the fleet plane
+(obs/fleet.py, ISSUE 12): "same seed retains the same traces,
+bundles the same incidents and federates the same fleet witness" is
+the identical replay contract, so a wall-clock read or entropy draw
+in a pin decision or a scrape round is the same class of bug as one
+in a sim world.
 """
 from __future__ import annotations
 
@@ -41,10 +43,11 @@ class _SimRule(Rule):
         parts = path_parts(path)
         if "sim" in parts:
             return True
-        # the retention layer makes seeded decisions under the same
-        # replay contract as sim worlds
+        # the retention layer and the fleet plane make seeded
+        # decisions under the same replay contract as sim worlds
         return "obs" in parts and parts[-1] in ("flight.py",
-                                                "incident.py")
+                                                "incident.py",
+                                                "fleet.py")
 
 
 @register

@@ -131,6 +131,21 @@ def main(argv=None) -> int:
                          "as one JSON file per incident (render with "
                          "tools/incident_view.py). Requires --trace; "
                          "absent = zero-cost off")
+    ap.add_argument("--fleet", action="store_true",
+                    help="arm the fleet observability plane "
+                         "(cess_tpu/obs/fleet.py) on this node: the "
+                         "gossip layer exchanges scrape contributions "
+                         "with peers every few slots and the node "
+                         "federates them — instance-labeled metric "
+                         "federation with counter-reset clamping, a "
+                         "global per-class SLO view (worst-of + "
+                         "quorum), cross-node trace stitching and MAD "
+                         "straggler detection — served via the "
+                         "cess_fleetStatus RPC (render with "
+                         "tools/fleet_view.py). With --flight, "
+                         "incident bundles gain the stitched "
+                         "cross-node trace view. Absent = zero-cost "
+                         "off (the --trace contract)")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="TARGETS",
                     help="attach an SLO board (cess_tpu/obs/slo.py) to "
@@ -322,6 +337,7 @@ def main(argv=None) -> int:
     if reporter is not None:
         nodes[0].flight = recorder
         nodes[0].incidents = reporter  # cess_incidentDump RPC surface
+    plane = _arm_cli_fleet(args, nodes[0], reporter)
     rpc = None
     import threading
 
@@ -345,6 +361,11 @@ def main(argv=None) -> int:
                       f"state={head.state_root.hex()[:16]} "
                       f"finalized=#{nodes[0].finalized}", file=sys.stderr)
             slot += 1
+            # single-process deployment: no gossip to scrape peers
+            # over, so the plane ticks itself (self-only federation)
+            if plane is not None and slot % 4 == 0:
+                with chain_lock:
+                    plane.tick()
             if args.block_time:
                 time.sleep(args.block_time)
     except KeyboardInterrupt:
@@ -354,6 +375,7 @@ def main(argv=None) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)
     return 0
@@ -439,6 +461,49 @@ def _finish_cli_flight(args, recorder, reporter) -> None:
     print(f"flight recorder: {snap['pins']} pinned trace(s) "
           f"({snap['pinned_spans']} spans), {len(bundles)} incident "
           f"bundle(s){where}", file=sys.stderr)
+
+
+def _arm_cli_fleet(args, node, reporter):
+    """--fleet: arm a FleetPlane (obs/fleet.py) as ``node.fleet``.
+    In TCP mode the net author loop gossips this node's scrape to
+    peers every FLEET_EVERY slots and seals rounds over whatever
+    peers gossiped in; in-process mode ticks self-only rounds. The
+    self scrape source is the node's own /metrics exposition plus the
+    engine SLO board snapshot when one exists. With --flight, the
+    incident reporter's bundles gain the plane's stitched cross-node
+    trace view. Returns the plane or None."""
+    if not getattr(args, "fleet", False):
+        return None
+    from ..obs.fleet import FleetPlane
+    from .metrics import render_metrics
+
+    plane = FleetPlane(node.name)
+
+    def _source():
+        board = getattr(getattr(node, "engine", None), "slo", None)
+        return (render_metrics(node),
+                None if board is None else board.snapshot())
+
+    plane.attach_source(_source)
+    if reporter is not None:
+        reporter.stitcher = plane.stitcher
+    node.fleet = plane
+    return plane
+
+
+def _finish_cli_fleet(plane, tracer) -> None:
+    """Feed the run's own trace dump into the stitcher (so the final
+    fleet snapshot stitches this node's side of every cross-node hop)
+    and print the plane summary."""
+    if plane is None:
+        return
+    if tracer is not None:
+        plane.stitcher.add_dump(plane.instance, tracer.finished())
+    snap = plane.snapshot()
+    print(f"fleet plane: {snap['rounds']} scrape round(s), "
+          f"{len(snap['federation']['instances'])} instance(s), "
+          f"{snap['stitch']['spans']} stitched span(s)",
+          file=sys.stderr)
 
 
 def _make_cli_engine(args, spec):
@@ -601,6 +666,7 @@ def _run_tcp_node(args, spec) -> int:
     if reporter is not None:
         node.flight = recorder
         node.incidents = reporter     # cess_incidentDump RPC surface
+    plane = _arm_cli_fleet(args, node, reporter)
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
     rpc = None
@@ -632,6 +698,7 @@ def _run_tcp_node(args, spec) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)
     return 0

@@ -54,6 +54,15 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
                                    trace (gossip dedup keys on the
                                    INNER frame, so the span context
                                    never splits the seen-set)
+  ("fleet", (instance, exposition, slo_json))
+                                   fleet observability gossip
+                                   (obs/fleet.py): only emitted while
+                                   a fleet plane is armed (node.cli
+                                   --fleet), every FLEET_EVERY slots;
+                                   receivers with a plane buffer the
+                                   peer's scrape for their next round,
+                                   everyone else drops it. Never
+                                   re-gossiped.
 
 Authority discovery is STRUCTURED (cess_tpu/node/dht.py): a Kademlia
 DHT on a second OS-assigned port answers single-shot find_node /
@@ -88,6 +97,7 @@ SEEN_CAP = 8192      # generational dedup-set rotation threshold
 ERRORS_CAP = 256
 SEND_QUEUE_CAP = 256    # outbound frames buffered per connection
 SEND_TIMEOUT = 5.0      # stalled-socket kill switch (seconds)
+FLEET_EVERY = 4         # slots between fleet scrape gossip rounds
 
 
 @dataclasses.dataclass
@@ -569,6 +579,16 @@ class NodeService:
                 if not getattr(conn, "contact_sent", False):
                     conn.contact_sent = True
                     self._send(conn, ("contact", self.kad.self_contact))
+        elif kind == "fleet":
+            # fleet observability gossip (obs/fleet.py): a peer's
+            # scrape contribution, buffered into the local plane's
+            # next round when one is armed (node.cli --fleet) —
+            # one attribute load + None check otherwise. Malformed
+            # payloads are dropped inside ingest_frame; never
+            # re-gossiped (point-in-time data, not chain state).
+            plane = getattr(self.node, "fleet", None)
+            if plane is not None:
+                plane.ingest_frame(payload)
         elif kind == "status":
             peer_head, _, peer_fin = payload
             now = time.time()
@@ -738,6 +758,19 @@ class NodeService:
             for conn in list(self.conns):
                 if conn.alive:
                     self._send_status(conn)
+            # fleet observability (obs/fleet.py): every FLEET_EVERY
+            # slots an armed plane gossips this node's scrape to
+            # peers and seals a local round over whatever peers
+            # gossiped in since the last one. Disarmed cost: one
+            # attribute load + None check per slot.
+            plane = getattr(self.node, "fleet", None)
+            if plane is not None and slot % FLEET_EVERY == 0:
+                with self.lock:
+                    frame = plane.self_frame()
+                if frame is not None:
+                    self.broadcast(("fleet", frame), mark_seen=False)
+                    plane.ingest_frame(frame)
+                plane.seal_round()
             # finality healing: gossip is fire-and-forget and sync
             # re-fetches blocks, never votes — a vote relayed into a
             # partially-formed mesh is lost forever, which stalls

@@ -33,6 +33,10 @@ Methods:
                      (flight-recorder postmortems: incident bundles +
                       retention counters; obs/flight.py + incident.py,
                       armed via node.cli --flight)
+  cess_fleetStatus   (fleet observability plane: federated metrics,
+                      global SLO views, stitched cross-node traces,
+                      straggler state; obs/fleet.py, armed via
+                      node.cli --fleet)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -341,6 +345,13 @@ class RpcServer:
             if limit is not None and not isinstance(limit, int):
                 raise RpcError(INVALID_PARAMS, "expected [limit?] int")
             return reporter.dump(limit=limit)
+        if method == "cess_fleetStatus":
+            # fleet observability plane (obs/fleet.py): the federated
+            # metric view, global SLO board, stitched cross-node
+            # traces and straggler scan state. Null when the node runs
+            # without a fleet plane (node.cli --fleet).
+            plane = getattr(node, "fleet", None)
+            return None if plane is None else plane.snapshot()
         if method == "cess_sloStatus":
             # SLO observability debug surface (obs/slo.py): per-class
             # burn rates / states / transition log + per-tenant

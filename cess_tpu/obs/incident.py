@@ -18,6 +18,8 @@ trigger class       journal entry (subsystem, kind)
 ``invariant``       ``("sim", "invariant")`` (a chaos-world check failed)
 ``thread-escape``   ``("engine"|"stream", "escape")`` — an exception
                     escaping the batcher / stream driver
+``fleet-outlier``   ``("fleet", "outlier")`` — the fleet plane's MAD
+                    straggler detector flagged a node (obs/fleet.py)
 ==================  ========================================================
 
 Each bundle is self-contained: the pinned traces, the journal tail,
@@ -56,7 +58,7 @@ from .trace import _json_safe
 # journal reacts to host-timed p99 estimates, so it is evidence, not
 # witness)
 _CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
-                        "finality", "flight"))
+                        "finality", "flight", "fleet"))
 
 
 def _sanitize(value):
@@ -90,6 +92,11 @@ class IncidentReporter:
     board:         optional SloBoard when there is no engine (sim).
     plan:          optional FaultPlan whose ``fired_log`` each bundle
                    embeds (falls back to the process-armed plan).
+    stitcher:      optional obs/fleet.py TraceStitcher — bundles gain
+                   a ``stitched`` section (the cross-node trace view
+                   at trigger time) and canon gains its replay-stable
+                   witness, so a multi-host incident's postmortem
+                   holds ONE connected trace instead of N fragments.
     context:       optional callable returning a dict merged into each
                    bundle — sim runs supply the scenario seed +
                    witness needed to replay the episode.
@@ -98,7 +105,7 @@ class IncidentReporter:
     """
 
     def __init__(self, recorder, *, engine=None, board=None, plan=None,
-                 context=None, max_per_class: int = 4,
+                 stitcher=None, context=None, max_per_class: int = 4,
                  max_bundles: int = 32, shed_storm: int = 8,
                  journal_tail: int = 64):
         if max_per_class < 1 or max_bundles < 1 or shed_storm < 1:
@@ -108,6 +115,7 @@ class IncidentReporter:
         self.board = board if board is not None \
             else getattr(engine, "slo", None)
         self.plan = plan
+        self.stitcher = stitcher
         self.context = context
         self.max_per_class = max_per_class
         self.shed_storm = shed_storm
@@ -156,6 +164,11 @@ class IncidentReporter:
             self.trigger("thread-escape",
                          key=f"{subsystem}:{detail.get('error')}",
                          detail=dict(detail, thread=subsystem))
+        elif subsystem == "fleet" and kind == "outlier":
+            self.trigger("fleet-outlier",
+                         key=f"{detail.get('instance')}:"
+                             f"{detail.get('metric')}",
+                         detail=detail)
 
     # -- triggering ----------------------------------------------------------
     def trigger(self, cls: str, key: str, detail: dict) -> dict | None:
@@ -210,6 +223,8 @@ class IncidentReporter:
         admission = getattr(engine, "admission", None)
         if admission is not None:
             snapshots["admission"] = admission.snapshot()
+        stitcher = self.stitcher
+        stitched = [] if stitcher is None else stitcher.traces()
         with self._mu:
             delta = {k: round(v - self._last_metrics.get(k, 0.0), 6)
                      for k, v in metrics.items()
@@ -231,6 +246,11 @@ class IncidentReporter:
             "faults": _sanitize(fired),
             "context": context,
         }
+        if stitcher is not None:
+            # structure only (uids, parent edges, truncation marks):
+            # the stitched WITNESS is replay-stable; the full traces
+            # carry host timings and stay evidence-side below
+            canon["stitched"] = _sanitize(stitcher.witness())
         return {
             "seq": seq,
             "trigger": cls,
@@ -238,6 +258,7 @@ class IncidentReporter:
             "detail": _sanitize(detail),
             "journal": _sanitize(journal),
             "pinned": _sanitize(pinned),
+            "stitched": _sanitize(stitched),
             "metrics_delta": delta,
             "snapshots": _sanitize(snapshots),
             "faults": _sanitize(fired),

@@ -78,6 +78,57 @@ class Histogram:
         with self._mu:
             return self._count
 
+    @classmethod
+    def from_cumulative(cls, buckets, total_sum: float) -> "Histogram":
+        """Rebuild a histogram from its wire form — the CUMULATIVE
+        ``[(le_bound, count_le)...]`` list :meth:`snapshot` produces
+        (and a federator parses back out of ``_bucket{le=...}``
+        samples). The last entry must be the ``+Inf`` bucket; counts
+        must be nondecreasing. Inverse of :meth:`snapshot`, so
+        cross-node federation can reuse :meth:`merge`."""
+        pairs = [(float(b), int(n)) for b, n in buckets]
+        if len(pairs) < 2 or not math.isinf(pairs[-1][0]):
+            raise ValueError("cumulative buckets must end with +Inf")
+        if any(n2 < n1 for (_, n1), (_, n2) in zip(pairs, pairs[1:])):
+            raise ValueError("cumulative bucket counts must be "
+                             "nondecreasing")
+        h = cls(tuple(b for b, _ in pairs[:-1]))
+        prev = 0
+        with h._mu:
+            for i, (_, acc) in enumerate(pairs):
+                h._counts[i] = acc - prev
+                prev = acc
+            h._count = pairs[-1][1]
+            h._sum = float(total_sum)
+        return h
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0 <= q <= 1) by linear
+        interpolation inside the owning bucket — the same estimate
+        ``histogram_quantile`` computes server-side, so a FleetBoard
+        reading a federated histogram agrees with the dashboards.
+        Observations above the last finite bound clamp to that bound
+        (the +Inf bucket has no width to interpolate over); an empty
+        histogram reports 0.0."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q!r}")
+        snap = self.snapshot()
+        total = snap["count"]
+        if total == 0:
+            return 0.0
+        target = q * total
+        lo, prev_acc = 0.0, 0
+        for bound, acc in snap["buckets"]:
+            if acc >= target and acc > prev_acc:
+                if math.isinf(bound):
+                    return lo
+                frac = (target - prev_acc) / (acc - prev_acc)
+                return lo + (bound - lo) * frac
+            if not math.isinf(bound):
+                lo = bound
+            prev_acc = acc
+        return lo
+
     def snapshot(self) -> dict:
         """One consistent view: ``buckets`` is the CUMULATIVE
         ``[(le_bound, count_le)...]`` list ending with ``(inf, count)``
@@ -91,6 +142,20 @@ class Histogram:
             buckets.append((bound, acc))
         buckets.append((math.inf, acc + counts[-1]))
         return {"buckets": buckets, "sum": total_sum, "count": total_n}
+
+
+def counter_delta(prev: float, cur: float) -> float:
+    """The increment between two scrapes of a MONOTONIC counter,
+    clamped for restarts: a counter can only move backwards because
+    the process restarted and began again at zero, so the true
+    increment since the previous scrape is at least ``cur`` (what
+    accumulated after the restart) — never the negative difference a
+    naive ``cur - prev`` would report. This is the federation-side
+    half of Prometheus's ``rate()`` reset handling."""
+    prev, cur = float(prev), float(cur)
+    if cur >= prev:
+        return cur - prev
+    return cur
 
 
 def format_le(bound: float) -> str:

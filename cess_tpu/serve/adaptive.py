@@ -30,6 +30,11 @@ applied to the RS/PoDR2 classes:
   request whose deadline is already below the class's live p99
   estimate is rejected at submit instead of timing out in the queue
   (the engine never spends queue slots on work it cannot deliver).
+  ``attach_fleet`` widens the trigger set from the local board to a
+  FleetBoard global view (obs/fleet.py): a quorum of the fleet burning
+  on a protected class engages the identical response, which is how a
+  multi-host deployment turns the federated SLO picture into
+  backpressure at every gateway.
 
 Both objects are opt-in (`make_engine(slo=..., adaptive=...)`,
 ``node.cli --slo --adaptive``) and cost nothing when absent: the
@@ -253,7 +258,20 @@ class AdmissionController:
         self._holds = 0
         self._releases = 0
         self._sheds: dict[str, dict[str, int]] = {}
+        self._fleet_view: str | None = None
         board.add_listener(self._on_transition)
+
+    def attach_fleet(self, fleet_board, *, view: str = "quorum") -> None:
+        """Extend protection fleet-wide: subscribe to an
+        obs.fleet.FleetBoard so a ``burning`` transition of the chosen
+        global view (``quorum`` by default — a strict majority of nodes
+        burning; ``worst`` for any single node) on a protected class
+        engages the same shed/degrade response as a local transition.
+        Fleet triggers are tracked as ``fleet:<cls>`` keys alongside the
+        local ones, so protection releases only when BOTH the local
+        board and the fleet view have recovered to ``ok``."""
+        self._fleet_view = view
+        fleet_board.add_listener(self._on_fleet_transition)
 
     def bind(self, engine) -> None:
         """Attach to an engine: grab the breakers the degrade response
@@ -267,15 +285,25 @@ class AdmissionController:
     def _on_transition(self, cls: str, old: str, new: str) -> None:
         if cls not in self.protect:
             return
+        self._apply(cls, new, f"slo:{cls}")
+
+    # -- the fleet board's listener seam (attach_fleet) ----------------------
+    def _on_fleet_transition(self, cls: str, view: str, old: str,
+                             new: str) -> None:
+        if view != self._fleet_view or cls not in self.protect:
+            return
+        self._apply(f"fleet:{cls}", new, f"fleet:{cls}")
+
+    def _apply(self, key: str, new: str, hold_reason: str) -> None:
         engage = release = False
         with self._mu:
             if new == "burning":
-                self._burning.add(cls)
+                self._burning.add(key)
                 if not self._engaged:
                     self._engaged = engage = True
                     self._holds += 1
             elif new == "ok":
-                self._burning.discard(cls)
+                self._burning.discard(key)
                 if self._engaged and not self._burning:
                     self._engaged = False
                     release = True
@@ -284,7 +312,7 @@ class AdmissionController:
         # monitor, and never while more than one is held)
         if engage:
             for mon in self._monitors:
-                mon.hold_open(f"slo:{cls}")
+                mon.hold_open(hold_reason)
         if release:
             for mon in self._monitors:
                 mon.release()
@@ -339,4 +367,5 @@ class AdmissionController:
                 "protect": list(self.protect),
                 "shed_classes": list(self.shed),
                 "degrade": bool(self._monitors),
+                "fleet_view": self._fleet_view,
             }

@@ -19,9 +19,14 @@ The four core invariants (ISSUE 8):
 - ``storage-convergence``: once a file is active, every honest alive
   assigned miner holds bytes matching the on-chain fragment hash.
 
-Plus two supporting checks scenarios opt into: ``heads-converged``
-(post-heal: one head, one state root) and ``restoral-single-winner``
-(the restoral market pays exactly one rescuer per broken fragment).
+Plus supporting checks scenarios opt into: ``heads-converged``
+(post-heal: one head, one state root), ``restoral-single-winner``
+(the restoral market pays exactly one rescuer per broken fragment)
+and ``fleet-consistency`` (ISSUE 12: the fleet plane's global views
+must be re-derivable from the per-node states it ingested — worst-of
+and quorum recomputed from scratch must match the FleetBoard,
+federated counters must be nonnegative, and no stitched span may
+reference a parent uid outside its trace).
 """
 from __future__ import annotations
 
@@ -194,6 +199,55 @@ def check_restoral_single_winner(world) -> list[str]:
     return out
 
 
+def check_fleet_consistency(world) -> list[str]:
+    """Global fleet state must be DERIVABLE from per-node states: the
+    FleetBoard's worst/quorum views recomputed from the node states it
+    holds must match what it reports, every federated counter must be
+    nonnegative (reset clamping can never produce a negative
+    cumulative), and the stitched trace set must be internally
+    consistent (every resolved parent uid exists in its trace)."""
+    plane = getattr(world, "fleet", None)
+    if plane is None:
+        return []
+    from ..obs import fleet as _fleet
+
+    out = []
+    board = plane.board.snapshot()
+    for cls, view in board["classes"].items():
+        states = [view["nodes"][i] for i in sorted(view["nodes"])]
+        if not states:
+            continue
+        worst = max(states,
+                    key=lambda s: _fleet._SEVERITY.get(s, 0))
+        if view["worst"] != worst:
+            out.append(
+                f"fleet-consistency: class {cls} worst view "
+                f"{view['worst']!r} but per-node states derive "
+                f"{worst!r}")
+        quorum = _fleet._quorum_state(states)
+        if view["quorum"] != quorum:
+            out.append(
+                f"fleet-consistency: class {cls} quorum view "
+                f"{view['quorum']!r} but per-node states derive "
+                f"{quorum!r}")
+    fed = plane.federator.snapshot()
+    for key, value in fed["counters"].items():
+        if value < 0:
+            out.append(
+                f"fleet-consistency: federated counter {key} is "
+                f"negative ({value}) — reset clamping failed")
+    for t in plane.stitcher.traces():
+        uids = {s["uid"] for s in t["spans"]}
+        for s in t["spans"]:
+            parent = s["parent_uid"]
+            if parent is not None and parent not in uids:
+                out.append(
+                    f"fleet-consistency: stitched span {s['uid']} "
+                    f"resolves parent {parent} outside its trace "
+                    f"{t['trace_id']}")
+    return out
+
+
 CHECKERS = {
     "finalized-prefix": check_finalized_prefix,
     "vote-locks": check_vote_locks,
@@ -201,6 +255,7 @@ CHECKERS = {
     "storage-convergence": check_storage_convergence,
     "heads-converged": check_heads_converged,
     "restoral-single-winner": check_restoral_single_winner,
+    "fleet-consistency": check_fleet_consistency,
 }
 
 

@@ -59,6 +59,13 @@ class Scenario:
       chaos campaigns exercise the real multi-lane serving plane;
       the pool snapshot rides :attr:`SimReport.pool` and lane
       breaker trips land in the armed flight recorder's journal.
+    - ``fleet``: arm a :class:`~cess_tpu.obs.fleet.FleetPlane` as
+      ``world.fleet`` and run one count-sequenced fleet scrape round
+      per virtual round: every alive node contributes a head-lag
+      derived SLO state + straggler sample, and every
+      :data:`_FLEET_FEDERATE_EVERY`-th round its full /metrics
+      exposition. The plane rides :attr:`SimReport.fleet` and its
+      witness joins :meth:`SimReport.witness` as the fifth stream.
     """
 
     name: str
@@ -70,6 +77,7 @@ class Scenario:
     checks: tuple = ("finalized-prefix", "vote-locks")
     final_checks: tuple = ()
     pool: bool = False
+    fleet: bool = False
 
 
 def resolve_ref(world: World, ref: str) -> int:
@@ -135,6 +143,11 @@ class SimReport:
     # of the witness: lane timing is wall-clock, outputs are
     # bit-identical to the single-device engine by construction
     pool: "dict | None" = None
+    # the fleet observability plane (ISSUE 12): the run's FleetPlane
+    # when the scenario ran ``fleet=True`` — its witness (federated
+    # snapshot + FleetBoard transition log + stitched trace set) IS
+    # part of the replay contract, as the fifth witness stream
+    fleet: "object | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -142,7 +155,8 @@ class SimReport:
         return (self.world.queue.fired_log(),
                 self.world.finalized_prefix(),
                 self.board.transition_log(),
-                self.plan.fired_log() if self.plan is not None else ())
+                self.plan.fired_log() if self.plan is not None else (),
+                self.fleet.witness() if self.fleet is not None else b"")
 
 
 def _build_world(scenario: Scenario, seed, n_nodes: int | None) -> World:
@@ -240,6 +254,44 @@ def _apply_action(world: World, pending: dict, rnd: int,
         raise ValueError(f"unknown scenario action {action!r}")
 
 
+# every node's SLO state + straggler sample feeds the fleet plane
+# each round; full /metrics expositions federate every N-th round
+# (render_metrics walks runtime state, so scraping 100 nodes every
+# round would dominate the run without observing anything new)
+_FLEET_FEDERATE_EVERY = 4
+
+
+def _fleet_scrape(world: World, plane, rnd: int) -> None:
+    """One count-sequenced fleet scrape round over the world. Each
+    alive node contributes a deterministic SLO snapshot derived from
+    its head lag behind the best alive chain (lagging <=1 slot of
+    chain is healthy, <=4 is warn, beyond burns — virtual-chain
+    state, never host timing), and the same lag feeds its straggler
+    window; every ``_FLEET_FEDERATE_EVERY``-th round the node's full
+    /metrics exposition federates too. Crashed nodes skip the round —
+    their last reported state stands, exactly like a silent peer."""
+    from ..node.metrics import render_metrics
+
+    heads = {i: world.nodes[i].chain[-1].number
+             for i in range(world.n) if world.alive[i]}
+    if not heads:
+        return
+    best = max(heads.values())
+    federate = rnd % _FLEET_FEDERATE_EVERY == 0
+    for i in sorted(heads):
+        inst = f"n{i:03d}"
+        lag = float(best - heads[i])
+        state = "ok" if lag <= 1 else ("warn" if lag <= 4
+                                       else "burning")
+        plane.ingest(
+            inst,
+            exposition=render_metrics(world.nodes[i])
+            if federate else None,
+            slo={"targets": {"head": {"state": state}}})
+        plane.stragglers.observe(inst, "head_lag", lag)
+    plane.seal_round()
+
+
 def _pool_engine(world: World):
     """A device-pool submission engine matched to the world's storage
     pipeline: same RS geometry, same PoDR2 key (a mismatched key would
@@ -281,6 +333,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
         objectives=dict(scenario.slo))
     plan = None
     reporter = None
+    fleet_plane = None
     stack = contextlib.ExitStack()
     try:
         with stack:
@@ -310,10 +363,21 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                     eng.pool.snapshot()))
                 stack.callback(setattr, world.pipeline, "engine", None)
                 world.pipeline.engine = eng
+            if scenario.fleet:
+                # the fleet observability plane (obs/fleet.py): armed
+                # as world.fleet so the fleet-consistency checker can
+                # recompute its global views from the ingested
+                # per-node states; one scrape round per virtual round
+                from ..obs.fleet import FleetPlane
+
+                fleet_plane = FleetPlane("sim")
+                world.fleet = fleet_plane
             # each bundle embeds the scenario identity + the live
             # witness streams — everything a replay needs
             reporter = IncidentReporter(
                 recorder, board=board, plan=plan,
+                stitcher=None if fleet_plane is None
+                else fleet_plane.stitcher,
                 context=lambda: {
                     "scenario": scenario.name,
                     "seed": seed_b.hex(),
@@ -339,9 +403,19 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                     active += _drive_uploads(world, pending, board, rnd)
                     board.observe("round",
                                   latency_s=float(world.last_round_slots))
+                    if fleet_plane is not None:
+                        _fleet_scrape(world, fleet_plane, rnd)
                     run_checks(world, scenario.checks,
                                context=f"{scenario.name}:round{rnd}",
                                strict=strict)
+            if fleet_plane is not None:
+                # stitch the run's own evidence: the armed tracer's
+                # ring (every sim.round trace) and the recorder's
+                # pins — overlapping spans dedup by (trace, span) id
+                if tracer is not None:
+                    fleet_plane.stitcher.add_dump(
+                        "sim", tracer.finished())
+                fleet_plane.stitcher.add_pins("sim", recorder.pinned())
             run_checks(world, scenario.final_checks,
                        context=f"{scenario.name}:final", strict=strict)
     except InvariantViolation as e:
@@ -354,7 +428,8 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     return SimReport(scenario=scenario.name, seed=seed_b, world=world,
                      board=board, plan=plan, rounds_run=scenario.rounds,
                      uploads_active=active, recorder=recorder,
-                     reporter=reporter, pool=pool_snap or None)
+                     reporter=reporter, pool=pool_snap or None,
+                     fleet=fleet_plane)
 
 
 # -- the library --------------------------------------------------------------
@@ -439,6 +514,30 @@ SCENARIOS: dict[str, Scenario] = {
         faults=(("engine.dispatch.d0", 1.0, "raise"),),
         slo=(("round", 4.0), ("upload", 2.0)),
         checks=("finalized-prefix", "vote-locks"),
+        final_checks=("storage-convergence",),
+    ),
+    # the hotspot observed by the FLEET plane (ISSUE 12): every round
+    # each alive node reports a head-lag SLO state + straggler sample
+    # and periodically its full /metrics exposition; a 4-way stripe
+    # partition mid-run makes lagging groups drift — the FleetBoard's
+    # worst and quorum views both flip to warn and recover after the
+    # heal, the MAD detector flags the laggards (fleet-outlier
+    # incident bundles), the fleet-consistency checker re-derives the
+    # global views from the ingested per-node states every round, and
+    # the plane's witness joins the replay contract
+    "gateway_hotspot_fleet": Scenario(
+        name="gateway_hotspot_fleet", rounds=14, fleet=True,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 4), ("n_gateways", 2)))),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000, 2),
+            (3, "upload", 0, "alice", 20_000, 2),
+            (4, "stripe", 4),
+            (6, "upload", 1, "alice", 20_000),
+            (9, "heal",),
+        ),
+        slo=(("round", 4.0), ("upload", 2.0)),
+        checks=("finalized-prefix", "vote-locks", "fleet-consistency"),
         final_checks=("storage-convergence",),
     ),
     # a miner loses a fragment; TWO non-assigned rescuers race the

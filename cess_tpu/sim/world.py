@@ -163,6 +163,10 @@ class World:
         self.slot = 0
         self.last_round_slots = 0
         self.agents: dict[str, object] = {}
+        # the fleet observability plane (obs/fleet.py): armed by
+        # fleet=True scenarios; None keeps every fleet hook a single
+        # attribute load + None check (the zero-cost-off contract)
+        self.fleet = None
         if storage is not None:
             storage.install(self)
 

@@ -29,6 +29,7 @@ EXPECTED = (
     "rs_4p8_encode_GiBps_per_chip",
     "pool_stream_encode_tag_GiBps",
     "pool_podr2_tag_verify_frags_per_s",
+    "fleet_federate_100nodes_ms",
 )
 
 
@@ -112,6 +113,14 @@ def test_bench_smoke_every_metric_finite():
         assert math.isfinite(pool["scaling_efficiency"]) \
             and pool["scaling_efficiency"] > 0, name
     assert got["pool_podr2_tag_verify_frags_per_s"]["lanes_used"] >= 2
+    # the fleet federation metric (ISSUE 12): the SAME 100-node shape
+    # runs under --smoke — parse + clamp + merge + board + scan over
+    # 100 synthesized expositions, with the federated series counts
+    # riding along so a silently-empty federation can't pass
+    fl = got["fleet_federate_100nodes_ms"]
+    assert fl["n_nodes"] == 100
+    assert fl["counters"] >= 100 and fl["gauges"] >= 100
+    assert fl["histograms"] >= 1
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:
@@ -254,3 +263,62 @@ class TestBenchDiff:
                                    os.path.join(DATA, "nope.json"))
         assert code == 2
         assert "nope.json" in err
+
+
+class TestBenchHistory:
+    """ISSUE 12 satellite: --history renders the full per-round
+    trajectory and flags plateaus — both the strict >= 3-round kind
+    and the 2-round trailing kind that may be a plateau in the
+    making."""
+    FIX = [os.path.join(DATA, f"bench_history_{r}.jsonl")
+           for r in "abcd"]
+
+    def test_fixture_trajectory_flags_plateaus(self):
+        code, out, _ = _bench_diff("--history", *self.FIX, "--json")
+        assert code == 0, out
+        rep = json.loads(out)
+        assert len(rep["rounds"]) == 4
+        # codec is flat (< 2% per round) across all 4 rounds: the
+        # strict plateau flag fires, and the run reaches the newest
+        # round so it is also ongoing
+        assert rep["flagged"] == ["codec_GiBps"]
+        codec = rep["metrics"]["codec_GiBps"]["plateaus"]
+        assert codec == [{"start": "bench_history_a.jsonl",
+                          "end": "bench_history_d.jsonl",
+                          "rounds": 4, "ongoing": True}]
+        # repair moved hard then went flat for the last 2 rounds: a
+        # trailing plateau NOTE, never the >= 3-round flag
+        repair = rep["metrics"]["repair_p99_ms"]["plateaus"]
+        assert repair == [{"start": "bench_history_c.jsonl",
+                           "end": "bench_history_d.jsonl",
+                           "rounds": 2, "ongoing": True}]
+        # a steadily-improving metric has no plateau at all
+        assert rep["metrics"]["verify_frags_per_s"]["plateaus"] == []
+        # a metric absent in early rounds renders as None, and its
+        # flat tail still registers
+        fleet = rep["metrics"]["fleet_federate_100nodes_ms"]
+        assert fleet["values"][:2] == [None, None]
+
+    def test_real_records_surface_the_codec_ceiling(self):
+        # the checked-in BENCH_r01..r05 trajectory: the r04 -> r05
+        # ~64 GiB/s encode ceiling must surface as an ongoing trailing
+        # plateau (VERDICT r5: the optimization curve went flat)
+        code, out, _ = _bench_diff("--history", "--json")
+        assert code == 0, out
+        rep = json.loads(out)
+        assert rep["rounds"][0] == "r01" and rep["rounds"][-1] == "r05"
+        enc = rep["metrics"]["rs_4p8_encode_GiBps_per_chip"]["plateaus"]
+        assert enc and enc[-1]["ongoing"] is True
+        assert enc[-1]["end"] == "r05" and enc[-1]["rounds"] >= 2
+
+    def test_text_mode_and_usage_errors(self):
+        code, out, _ = _bench_diff("--history", *self.FIX)
+        assert code == 0
+        assert "PLATEAU" in out and "codec_GiBps" in out
+        assert "trailing plateau" in out
+        # two records without --history is a usage error pointing at it
+        code, _, err = _bench_diff(self.FIX[0], self.FIX[1])
+        assert code == 2 and "--history" in err
+        # history over a single record cannot show a trajectory
+        code, _, err = _bench_diff("--history", self.FIX[0])
+        assert code == 2 and "two" in err

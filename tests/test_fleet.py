@@ -1,0 +1,735 @@
+"""Fleet observability plane (ISSUE 12, cess_tpu/obs/fleet).
+
+Pins, in order: the prom.py additions the plane stands on (cumulative
+rebuild, quantile interpolation, counter reset clamping), exposition
+parsing, the MetricFederator (instance labeling, restart clamping,
+cross-node histogram merges, order-independent determinism), the
+FleetBoard (worst vs quorum views, the deterministic transition log
+and its announce path), the TraceStitcher (dedup, cross-instance
+parent resolution, loopback, ``remote_truncated``), the
+StragglerDetector (MAD outliers, edge-triggered firing, the
+``fleet-outlier`` incident trigger), FleetPlane frame hygiene and the
+zero-cost-when-off contract — then THE acceptance drills: a seeded
+two-node incident episode whose bundle embeds one stitched trace
+spanning both nodes, a 100-node sim scenario whose fleet witness
+replays byte-identically, and a two-PROCESS run over real TCP whose
+per-node trace dumps stitch into one connected cross-node trace.
+"""
+import math
+import multiprocessing as mp
+import socket
+import time
+
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.obs import flight, prom, trace
+from cess_tpu.obs.fleet import (FleetBoard, FleetPlane, MetricFederator,
+                                StragglerDetector, TraceStitcher,
+                                _quorum_state, parse_exposition)
+from cess_tpu.obs.incident import IncidentReporter
+
+D = constants.DOLLARS
+SLOT = 0.25
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    trace.disarm()
+    flight.disarm()
+
+
+# -- prom.py additions: what federation stands on ----------------------------
+class TestHistogramQuantile:
+    def test_linear_interpolation_inside_the_owning_bucket(self):
+        h = prom.Histogram.from_cumulative(
+            [(0.5, 2), (1.0, 6), (math.inf, 6)], 4.2)
+        # target rank 3 of 6 lands in the (0.5, 1.0] bucket at
+        # fraction (3-2)/(6-2): 0.5 + 0.5 * 0.25
+        assert h.quantile(0.5) == pytest.approx(0.625)
+        # rank 6 of 6: the upper edge of the last occupied bucket
+        assert h.quantile(1.0) == pytest.approx(1.0)
+
+    def test_edges_empty_clamp_and_range(self):
+        h = prom.Histogram(bounds=(1.0, 2.0))
+        assert h.quantile(0.5) == 0.0            # empty histogram
+        # everything above the last finite bound: clamp to that bound
+        # (the +Inf bucket has no width to interpolate over)
+        h2 = prom.Histogram.from_cumulative(
+            [(1.0, 0), (math.inf, 3)], 9.0)
+        assert h2.quantile(0.99) == 1.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        with pytest.raises(ValueError):
+            h.quantile(-0.1)
+
+    def test_matches_observe_side(self):
+        h = prom.Histogram(bounds=(1.0, 2.0, 4.0))
+        for v in (0.5, 0.5, 1.5, 1.5, 1.5, 1.5):
+            h.observe(v)
+        # rank 3 of 6 is the first (1.0, 2.0] observation: frac
+        # (3-2)/(6-2) into a width-1 bucket
+        assert h.quantile(0.5) == pytest.approx(1.25)
+
+    def test_from_cumulative_round_trip_and_validation(self):
+        h = prom.Histogram(bounds=(0.1, 1.0))
+        for v in (0.05, 0.5, 3.0):
+            h.observe(v)
+        snap = h.snapshot()
+        back = prom.Histogram.from_cumulative(snap["buckets"],
+                                              snap["sum"])
+        assert back.snapshot() == snap
+        with pytest.raises(ValueError):
+            prom.Histogram.from_cumulative([(1.0, 3)], 1.0)  # no +Inf
+        with pytest.raises(ValueError):
+            prom.Histogram.from_cumulative(
+                [(1.0, 3), (math.inf, 2)], 1.0)  # decreasing counts
+
+
+class TestCounterDelta:
+    def test_monotonic_increment(self):
+        assert prom.counter_delta(5, 9) == 4.0
+        assert prom.counter_delta(5, 5) == 0.0
+
+    def test_reset_clamps_to_post_restart_accumulation(self):
+        # the counter went backwards: the process restarted at zero,
+        # so the true increment is at least cur — never negative
+        assert prom.counter_delta(100, 7) == 7.0
+        assert prom.counter_delta(1, 0) == 0.0
+
+
+# -- exposition parsing ------------------------------------------------------
+class TestParseExposition:
+    def test_types_samples_and_counter_inference(self):
+        p = parse_exposition(
+            "# TYPE cess_block_height gauge\n"
+            "cess_block_height 42\n"
+            "cess_gossip_frames_total 7\n")
+        assert p["types"] == {"cess_block_height": "gauge"}
+        assert ("cess_block_height", (), 42.0) in p["samples"]
+        assert ("cess_gossip_frames_total", (), 7.0) in p["samples"]
+
+    def test_label_unescape_round_trips_render(self):
+        # prom.escape_label is the writer; the parser must invert it
+        raw = 'evil "name"\nwith\\backslash'
+        text = "m" + prom.format_labels({"k": raw, "z": "plain"}) + " 1\n"
+        p = parse_exposition(text)
+        assert p["samples"] == [("m", (("k", raw), ("z", "plain")), 1.0)]
+
+    def test_malformed_lines_are_skipped_not_fatal(self):
+        p = parse_exposition(
+            "ok_metric 1\n"
+            'bad_label{k=unquoted} 2\n'
+            'truncated{k="never-closed 3\n'
+            "not_a_number abc\n"
+            "trailing_garbage\n"
+            "ok_too 4\n")
+        assert [s[0] for s in p["samples"]] == ["ok_metric", "ok_too"]
+
+
+# -- metric federation -------------------------------------------------------
+def _expo(height, frames, extra=""):
+    return ("# TYPE cess_block_height gauge\n"
+            f"cess_block_height {height}\n"
+            "# TYPE cess_gossip_frames_total counter\n"
+            f"cess_gossip_frames_total {frames}\n" + extra)
+
+
+_HIST = ("# TYPE cess_upload_seconds histogram\n"
+         'cess_upload_seconds_bucket{{le="0.5"}} {a}\n'
+         'cess_upload_seconds_bucket{{le="2"}} {b}\n'
+         'cess_upload_seconds_bucket{{le="+Inf"}} {b}\n'
+         "cess_upload_seconds_sum {s}\n"
+         "cess_upload_seconds_count {b}\n")
+
+
+class TestMetricFederator:
+    def test_instance_labels_and_latest_gauges(self):
+        fed = MetricFederator()
+        fed.scrape_round({"a": _expo(3, 5), "b": _expo(9, 2)})
+        fed.scrape_round({"a": _expo(4, 6)})
+        snap = fed.snapshot()
+        assert snap["instances"] == ["a", "b"]
+        assert snap["gauges"]['cess_block_height{instance="a"}'] == 4.0
+        assert snap["gauges"]['cess_block_height{instance="b"}'] == 9.0
+
+    def test_counter_restart_clamps_never_negative(self):
+        fed = MetricFederator()
+        fed.scrape_round({"a": _expo(1, 5)})
+        fed.scrape_round({"a": _expo(1, 8)})    # +3
+        fed.scrape_round({"a": _expo(1, 2)})    # restart: contributes 2
+        snap = fed.snapshot()
+        key = 'cess_gossip_frames_total{instance="a"}'
+        assert snap["counters"][key] == 10.0
+        assert all(v >= 0 for v in snap["counters"].values())
+
+    def test_histograms_merge_across_instances(self):
+        fed = MetricFederator()
+        fed.scrape_round({
+            "a": _HIST.format(a=2, b=4, s=3.0),
+            "b": _HIST.format(a=1, b=2, s=1.5),
+        })
+        merged = fed.merged_histogram("cess_upload_seconds")
+        assert merged.count == 6
+        snap = merged.snapshot()
+        assert snap["buckets"][0] == (0.5, 3)
+        assert snap["sum"] == pytest.approx(4.5)
+        assert fed.snapshot()["histograms"][
+            "cess_upload_seconds"]["count"] == 6
+
+    def test_federation_is_order_independent(self):
+        expos = {"a": _expo(1, 5, _HIST.format(a=1, b=2, s=1.0)),
+                 "b": _expo(2, 6), "c": _expo(3, 7)}
+        f1, f2 = MetricFederator(), MetricFederator()
+        f1.scrape_round(expos)
+        f2.scrape_round(dict(reversed(list(expos.items()))))
+        assert f1.witness() == f2.witness()
+
+    def test_render_redeclares_types_once_per_family(self):
+        fed = MetricFederator()
+        fed.scrape_round({"a": _expo(1, 5), "b": _expo(2, 6)})
+        out = fed.render()
+        assert out.count("# TYPE cess_block_height gauge") == 1
+        assert out.count("# TYPE cess_gossip_frames_total counter") == 1
+        # the federated exposition is itself parseable
+        p = parse_exposition(out)
+        assert ("cess_block_height", (("instance", "a"),), 1.0) \
+            in p["samples"]
+
+
+# -- global SLO view ---------------------------------------------------------
+def _slo(state):
+    return {"targets": {"upload": {"state": state}}}
+
+
+class TestQuorumState:
+    def test_strict_majority_semantics(self):
+        assert _quorum_state(["burning", "ok", "ok", "ok", "ok"]) == "ok"
+        assert _quorum_state(["burning"] * 3 + ["ok"] * 2) == "burning"
+        assert _quorum_state(["warn", "warn", "burning", "ok", "ok"]) \
+            == "warn"              # 3 of 5 at warn-or-beyond
+        assert _quorum_state(["burning", "burning", "ok", "ok"]) == "ok"
+        assert _quorum_state([]) == "ok"
+
+
+class TestFleetBoard:
+    def test_worst_vs_quorum_views(self):
+        board = FleetBoard()
+        board.scrape_round({f"n{i}": _slo("ok") for i in range(4)})
+        board.scrape_round({"n0": _slo("burning")})
+        assert board.state("upload", view="worst") == "burning"
+        assert board.state("upload", view="quorum") == "ok"
+        assert board.burning(view="worst")
+        assert not board.burning(view="quorum")
+        board.scrape_round({f"n{i}": _slo("burning") for i in range(3)})
+        assert board.state("upload", view="quorum") == "burning"
+
+    def test_absent_instance_keeps_last_reported_state(self):
+        board = FleetBoard()
+        board.scrape_round({"n0": _slo("burning"), "n1": _slo("ok")})
+        board.scrape_round({"n1": _slo("ok")})    # n0 silent (crashed)
+        assert board.state("upload", view="worst") == "burning"
+        assert board.snapshot()["classes"]["upload"]["nodes"]["n0"] \
+            == "burning"
+
+    def test_transition_log_is_count_sequenced(self):
+        board = FleetBoard()
+        board.scrape_round({"n0": _slo("ok"), "n1": _slo("ok")})
+        board.scrape_round({"n0": _slo("burning"), "n1": _slo("burning")})
+        board.scrape_round({"n0": _slo("ok"), "n1": _slo("ok")})
+        assert board.transition_log() == (
+            ("upload", "worst", "ok", "burning", 2),
+            ("upload", "quorum", "ok", "burning", 2),
+            ("upload", "worst", "burning", "ok", 3),
+            ("upload", "quorum", "burning", "ok", 3))
+
+    def test_transitions_announce_span_note_and_listener(self):
+        tracer = trace.Tracer()
+        trace.arm(tracer)
+        rec = flight.FlightRecorder(b"fleet-board")
+        flight.arm(rec)
+        heard = []
+        board = FleetBoard()
+        board.add_listener(lambda *a: heard.append(a))
+        board.scrape_round({"n0": _slo("burning")})
+        assert ("upload", "worst", "ok", "burning") in heard
+        spans = [s for s in tracer.finished()
+                 if s["name"] == "fleet.transition"]
+        assert spans and spans[0]["attrs"]["view"] == "worst"
+        notes = [e for e in rec.journal_tail("fleet")
+                 if e["kind"] == "transition"]
+        assert notes and notes[0]["detail"]["to"] == "burning"
+
+    def test_p99_rides_the_snapshot(self):
+        board = FleetBoard()
+        board.scrape_round({"n0": _slo("ok")}, p99_s={"upload": 0.25})
+        assert board.snapshot()["classes"]["upload"]["p99_s"] == 0.25
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError):
+            FleetBoard(max_transitions=0)
+
+
+# -- cross-node trace stitching ----------------------------------------------
+def _span(sid, tid, parent=0, remote=False, name="s", inst_extra=()):
+    return dict({"name": name, "sys": "t", "span_id": sid,
+                 "parent_id": parent, "trace_id": tid,
+                 "remote_parent": remote}, **dict(inst_extra))
+
+
+class TestTraceStitcher:
+    def test_dedup_first_wins_within_instance(self):
+        st = TraceStitcher()
+        assert st.add_dump("a", [_span(1, 9, name="first")]) == 1
+        assert st.add_dump("a", [_span(1, 9, name="dupe")]) == 0
+        [t] = st.traces()
+        assert t["spans"][0]["name"] == "first"
+
+    def test_cross_instance_remote_parent_resolves(self):
+        st = TraceStitcher()
+        st.add_dump("a", [_span(1, 9, name="root"),
+                          _span(2, 9, parent=1, name="send")])
+        st.add_dump("b", [_span(1, 9, parent=2, remote=True,
+                                name="net.recv:tx")])
+        [t] = st.traces()
+        assert t["instances"] == ["a", "b"]
+        assert t["roots"] == ["a/1"]
+        by_uid = {s["uid"]: s for s in t["spans"]}
+        assert by_uid["b/1"]["parent_uid"] == "a/2"
+        assert by_uid["a/2"]["parent_uid"] == "a/1"
+        assert t["truncated"] == []
+
+    def test_loopback_remote_parent_falls_back_local(self):
+        st = TraceStitcher()
+        st.add_dump("a", [_span(1, 9), _span(2, 9, parent=1,
+                                             remote=True)])
+        [t] = st.traces()
+        assert {s["uid"]: s["parent_uid"] for s in t["spans"]} == {
+            "a/1": None, "a/2": "a/1"}
+
+    def test_unresolvable_parents_marked_remote_truncated(self):
+        st = TraceStitcher()
+        # a remote parent no retained dump contains (evicted ring)...
+        st.add_dump("a", [_span(3, 9, parent=7, remote=True)])
+        # ...and a LOCAL parent from a different trace id
+        st.add_dump("b", [_span(4, 8), _span(5, 9, parent=4)])
+        traces = {t["trace_id"]: t for t in st.traces()}
+        nine = traces[9]
+        assert nine["truncated"] == ["a/3", "b/5"]
+        assert all(s["parent_uid"] is None for s in nine["spans"])
+        assert nine["roots"] == []    # truncation points are not roots
+
+    def test_witness_is_structure_only(self):
+        st = TraceStitcher()
+        st.add_dump("a", [dict(_span(1, 9), dur_s=0.123,
+                               t_start=99.0)])
+        st2 = TraceStitcher()
+        st2.add_dump("a", [dict(_span(1, 9), dur_s=0.456,
+                                t_start=11.0)])
+        assert st.witness() == st2.witness()
+
+    def test_add_pins_and_garbage_tolerance(self):
+        st = TraceStitcher()
+        assert st.add_pins("a", [{"spans": [_span(1, 9)]},
+                                 "not-a-pin"]) == 1
+        assert st.add_dump("a", ["junk", {"no_span_id": 1}]) == 0
+        assert st.snapshot()["spans"] == 1
+
+
+# -- straggler detection -----------------------------------------------------
+def _feed(det, lags):
+    for inst, lag in lags.items():
+        det.observe(inst, "lag", lag)
+
+
+class TestStragglerDetector:
+    def test_mad_outlier_fires_edge_triggered(self):
+        rec = flight.FlightRecorder(b"straggler")
+        flight.arm(rec)
+        det = StragglerDetector(window=4, k=4.0, min_nodes=4)
+        for _ in range(3):
+            _feed(det, {"n0": 1.0, "n1": 1.1, "n2": 0.9, "n3": 9.0})
+            fired = det.scan()
+            # fires ONCE when n3 becomes an outlier, then stays quiet
+            if det.snapshot()["scans"] == 1:
+                assert [(f[0], f[1]) for f in fired] == [("n3", "lag")]
+            else:
+                assert fired == []
+        assert det.snapshot()["outliers"] == ["n3/lag"]
+        notes = [e for e in rec.journal_tail("fleet")
+                 if e["kind"] == "outlier"]
+        assert len(notes) == 1
+        assert notes[0]["detail"]["instance"] == "n3"
+
+    def test_rejoining_the_pack_rearms(self):
+        det = StragglerDetector(window=2, k=4.0, min_nodes=4)
+        _feed(det, {"n0": 1.0, "n1": 1.0, "n2": 1.0, "n3": 9.0})
+        assert det.scan()
+        _feed(det, {"n3": 1.0})
+        _feed(det, {"n3": 1.0})      # window now all healthy
+        assert det.scan() == []
+        assert det.snapshot()["outliers"] == []
+        _feed(det, {"n0": 9.0, "n1": 9.0, "n2": 9.0, "n3": 9.0})
+        _feed(det, {"n0": 9.0, "n1": 9.0, "n2": 9.0, "n3": 80.0})
+        assert det.scan()            # n3 deviates again: re-fired
+
+    def test_min_mad_floor_flags_the_one_deviant(self):
+        # an otherwise-IDENTICAL fleet has MAD 0; the floor keeps the
+        # deviant detectable instead of dividing by zero
+        det = StragglerDetector(window=1, k=4.0, min_nodes=4)
+        _feed(det, {"n0": 2.0, "n1": 2.0, "n2": 2.0, "n3": 2.0001})
+        assert [(f[0]) for f in det.scan()] == ["n3"]
+
+    def test_below_min_nodes_never_fires(self):
+        det = StragglerDetector(window=1, k=4.0, min_nodes=4)
+        _feed(det, {"n0": 1.0, "n1": 99.0, "n2": 1.0})
+        assert det.scan() == []
+
+    def test_bounds_validated(self):
+        for kw in ({"window": 0}, {"min_nodes": 1}, {"k": 0},
+                   {"min_mad": 0}):
+            with pytest.raises(ValueError):
+                StragglerDetector(**kw)
+
+    def test_outlier_note_is_the_incident_trigger(self):
+        rec = flight.FlightRecorder(b"outlier-inc")
+        flight.arm(rec)
+        reporter = IncidentReporter(rec)
+        det = StragglerDetector(window=1, k=4.0, min_nodes=4)
+        _feed(det, {"n0": 1.0, "n1": 1.0, "n2": 1.0, "n3": 50.0})
+        det.scan()
+        [bundle] = reporter.bundles()
+        assert bundle["trigger"] == "fleet-outlier"
+        assert bundle["key"] == "n3:lag"
+        assert bundle["detail"]["median"] == 1.0
+
+
+# -- the composite plane -----------------------------------------------------
+class TestFleetPlane:
+    def test_ingest_frame_drops_malformed(self):
+        plane = FleetPlane("self")
+        for frame in (None, 42, ("a",), ("a", "x", "y", "z"),
+                      (7, "expo", ""), ("a", 7, ""),
+                      ("a", "expo", "{not json"), ("a", "expo", "[1]")):
+            plane.ingest_frame(frame)
+        plane.seal_round()
+        assert plane.federator.snapshot()["instances"] == []
+
+    def test_tick_scrapes_self_and_peers(self):
+        plane = FleetPlane("self", latency_families={
+            "upload": "cess_upload_seconds"})
+        plane.attach_source(lambda: (
+            _expo(5, 1, _HIST.format(a=90, b=100, s=50.0)),
+            _slo("ok")))
+        peer = ("peer", _expo(3, 2), '{"targets": {"upload": '
+                                     '{"state": "burning"}}}')
+        plane.ingest_frame(peer)
+        plane.tick()
+        snap = plane.snapshot()
+        assert snap["rounds"] == 1
+        assert snap["federation"]["instances"] == ["peer", "self"]
+        assert snap["board"]["classes"]["upload"]["worst"] == "burning"
+        # fleet p99 came from the merged latency family
+        assert snap["board"]["classes"]["upload"]["p99_s"] > 0
+
+    def test_self_frame_none_without_source(self):
+        plane = FleetPlane("self")
+        assert plane.self_frame() is None
+        plane.tick()                 # still seals an (empty) round
+        assert plane.rounds == 1
+
+    def test_witness_deterministic_across_identical_feeds(self):
+        def run():
+            plane = FleetPlane("w")
+            for rnd in range(3):
+                plane.ingest("a", exposition=_expo(rnd, rnd * 2),
+                             slo=_slo("ok" if rnd < 2 else "burning"))
+                plane.ingest("b", exposition=_expo(rnd, rnd),
+                             slo=_slo("ok"))
+                plane.stragglers.observe("a", "lag", 1.0)
+                plane.stragglers.observe("b", "lag", 1.0)
+                plane.seal_round()
+            plane.stitcher.add_dump("a", [_span(1, 9)])
+            return plane.witness()
+        assert run() == run()
+
+    def test_world_and_node_are_zero_cost_off_by_default(self):
+        from cess_tpu.node import net as node_net
+        from cess_tpu.sim.world import World
+        world = World(seed=b"off", n_nodes=2, n_validators=2)
+        assert world.fleet is None
+        assert node_net.FLEET_EVERY >= 1
+
+
+# -- the serve-plane seam: fleet quorum drives admission ----------------------
+class TestFleetAdmissionSeam:
+    def test_quorum_burning_engages_and_releases_protection(self):
+        from cess_tpu.obs.slo import SloBoard, SloTarget
+        from cess_tpu.resilience import HealthMonitor
+        from cess_tpu.serve import AdmissionController
+
+        local = SloBoard((SloTarget("verify", 0.02, 0.01),),
+                         fast_window=4, slow_window=16, eval_every=4)
+        ctrl = AdmissionController(local, protect=("verify",),
+                                   shed=("encode",))
+
+        class EngineLike:
+            monitors = {"codec": HealthMonitor()}
+
+        eng = EngineLike()
+        ctrl.bind(eng)
+        fb = FleetBoard()
+        ctrl.attach_fleet(fb)
+        assert ctrl.snapshot()["fleet_view"] == "quorum"
+
+        def snap(state):
+            return {"targets": {"verify": {"state": state}}}
+
+        # one node burning: worst flips but quorum holds -> no response
+        fb.scrape_round({"n1": snap("burning"), "n2": snap("ok"),
+                         "n3": snap("ok")})
+        assert fb.state("verify", "worst") == "burning"
+        assert not ctrl.engaged
+        assert ctrl.admit("encode", 30.0) is None
+
+        # a strict majority burning: the quorum view engages the same
+        # shed + degrade response as a local burning transition
+        fb.scrape_round({"n1": snap("burning"), "n2": snap("burning"),
+                         "n3": snap("ok")})
+        assert ctrl.engaged
+        assert eng.monitors["codec"].state == "held"
+        assert ctrl.admit("encode", 30.0) == "slo-burning"
+        assert ctrl.admit("verify", 30.0) is None   # protected: never
+        assert ctrl.snapshot()["burning"] == ["fleet:verify"]
+
+        # fleet recovers (warn keeps protection, ok releases)
+        fb.scrape_round({"n1": snap("warn"), "n2": snap("warn"),
+                         "n3": snap("ok")})
+        assert ctrl.engaged
+        fb.scrape_round({"n1": snap("ok"), "n2": snap("ok"),
+                         "n3": snap("ok")})
+        assert not ctrl.engaged
+        assert eng.monitors["codec"].state == "closed"
+        assert ctrl.admit("encode", 30.0) is None
+        s = ctrl.snapshot()
+        assert s["holds"] == s["releases"] == 1
+        assert s["sheds"]["encode"]["slo-burning"] == 1
+
+    def test_local_and_fleet_triggers_release_independently(self):
+        from cess_tpu.obs.slo import SloBoard, SloTarget
+        from cess_tpu.serve import AdmissionController
+
+        local = SloBoard((SloTarget("verify", 0.02, 0.01),),
+                         fast_window=4, slow_window=16, eval_every=4)
+        ctrl = AdmissionController(local, protect=("verify",),
+                                   shed=("encode",))
+        fb = FleetBoard()
+        ctrl.attach_fleet(fb)
+
+        def snap(state):
+            return {"targets": {"verify": {"state": state}}}
+
+        for _ in range(8):
+            local.observe("verify", 1.0)            # local -> burning
+        fb.scrape_round({"n1": snap("burning"), "n2": snap("burning")})
+        assert set(ctrl.snapshot()["burning"]) == {"verify",
+                                                   "fleet:verify"}
+        # the fleet clears first: the LOCAL burn still holds protection
+        fb.scrape_round({"n1": snap("ok"), "n2": snap("ok")})
+        assert ctrl.engaged
+        for _ in range(24):
+            local.observe("verify", 0.001)          # local -> ok
+        assert not ctrl.engaged
+        assert ctrl.snapshot()["holds"] == 1        # one episode, not two
+
+
+# -- acceptance: the seeded two-node incident episode ------------------------
+class TestStitchedIncidentBundle:
+    @staticmethod
+    def _episode():
+        """One deterministic two-node episode: node a uploads, node b
+        receives under a remote-joined span, the fleet plane stitches
+        both dumps, then a straggler fires the incident."""
+        rec = flight.FlightRecorder(b"two-node")
+        flight.arm(rec)
+        plane = FleetPlane("a")
+        reporter = IncidentReporter(rec, stitcher=plane.stitcher)
+        ta = trace.Tracer(trace_id=11)
+        tb = trace.Tracer(trace_id=22)
+        root = ta.start("gw.upload", sys="gateway")
+        send = ta.start("net.send", sys="net", parent=root)
+        send.finish()
+        root.finish()
+        recv = tb.start("net.recv:tx", sys="net",
+                        remote=(11, send.span_id))
+        handle = tb.start("txpool.add", sys="txpool", parent=recv)
+        handle.finish()
+        recv.finish()
+        plane.stitcher.add_dump("a", ta.finished())
+        plane.stitcher.add_dump("b", tb.finished())
+        for rnd in range(2):
+            for inst, lag in (("a", 1.0), ("b", 1.0), ("c", 1.0),
+                              ("d", 60.0 if rnd else 1.0)):
+                plane.stragglers.observe(inst, "lag", lag)
+            plane.ingest("a", exposition=_expo(rnd, rnd))
+            plane.seal_round()
+        flight.disarm()
+        return plane, reporter
+
+    def test_bundle_contains_one_trace_spanning_both_nodes(self):
+        plane, reporter = self._episode()
+        [bundle] = [b for b in reporter.bundles()
+                    if b["trigger"] == "fleet-outlier"]
+        assert bundle["key"] == "d:lag"
+        spanning = [t for t in bundle["stitched"]
+                    if t["instances"] == ["a", "b"]]
+        assert len(spanning) == 1
+        [t] = spanning
+        assert t["trace_id"] == 11
+        assert t["roots"] == ["a/1"]
+        assert t["truncated"] == []
+        by_uid = {s["uid"]: s["parent_uid"] for s in t["spans"]}
+        # the cross-node edge: b's recv span hangs off a's send span
+        assert by_uid["b/1"] == "a/2"
+        # the canonical (replay-stable) form rides the bundle too
+        assert bundle["canon"]["stitched"]
+
+    def test_episode_replays_byte_identical(self):
+        p1, r1 = self._episode()
+        p2, r2 = self._episode()
+        assert p1.witness() == p2.witness()
+        assert [b["canon"] for b in r1.bundles()] \
+            == [b["canon"] for b in r2.bundles()]
+
+
+# -- acceptance: 100-node sim federation replays bit-identically -------------
+def test_100_node_fleet_scenario_replays_bit_identical():
+    """ISSUE 12 acceptance: two same-seed 100-node runs of the fleet
+    scenario produce byte-identical fleet witnesses (federated
+    snapshot + FleetBoard transition log + stitched trace set), and
+    the fleet witness rides the scenario's own replay witness."""
+    from cess_tpu.sim.scenarios import SCENARIOS, run_scenario
+    sc = SCENARIOS["gateway_hotspot_fleet"]
+    a = run_scenario(sc, b"fleet-accept", n_nodes=100)
+    b = run_scenario(sc, b"fleet-accept", n_nodes=100)
+    assert a.fleet is not None and b.fleet is not None
+    assert a.fleet.witness() == b.fleet.witness()
+    wa, wb = a.witness(), b.witness()
+    assert wa == wb
+    assert wa[4] == a.fleet.witness()    # the 5th witness element
+    # the run really federated at fleet scale and saw the partition
+    assert len(a.fleet.federator.snapshot()["instances"]) == 100
+    assert a.fleet.board.transition_log()
+
+
+# -- acceptance: cross-node stitching over real TCP --------------------------
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _stitch_worker(idx, ports, q, genesis_time):
+    from cess_tpu.chain.extrinsic import sign_extrinsic
+    from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis
+    from cess_tpu.node.net import NodeService
+    from cess_tpu.node.network import Node
+    from cess_tpu.obs import trace as obs_trace
+
+    spec = ChainSpec(
+        name="t", chain_id="fleet-stitch",
+        endowed=(("alice", 1_000_000_000 * D),),
+        validators=tuple(ValidatorGenesis(f"v{i}", 4_000_000 * D)
+                         for i in range(2)),
+        era_blocks=1000, epoch_blocks=1000, sudo="alice")
+    node = Node(spec, f"n{idx}", {f"v{idx}": spec.session_key(f"v{idx}")})
+    # per-node tracers with DISTINCT trace ids: span ids collide
+    # across nodes by construction, which is exactly what the
+    # stitcher's instance/span_id uids must untangle
+    tracer = obs_trace.Tracer(capacity=65536,
+                              trace_id=101 if idx == 0 else 202)
+    obs_trace.arm(tracer)
+    svc = NodeService(node, ports[idx],
+                      [p for j, p in enumerate(ports) if j != idx],
+                      slot_time=SLOT, genesis_time=genesis_time)
+    svc.start()
+    try:
+        if idx == 0:
+            time.sleep(4 * SLOT)    # let the mesh form
+            xt = sign_extrinsic(
+                spec.account_key("alice"), node.runtime.genesis_hash(),
+                "alice", 0, "balances.transfer", ("bob", 7 * D), ())
+            root = tracer.start("fleet.upload", sys="gateway",
+                                current=True)
+            try:
+                svc.submit(xt)      # broadcasts under the root span
+            finally:
+                root.finish()
+            time.sleep(8 * SLOT)    # keep serving while peer receives
+        else:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if any(s["name"] == "net.recv:tx"
+                       and s["trace_id"] == 101
+                       for s in tracer.finished()):
+                    break
+                time.sleep(0.1)
+            time.sleep(2 * SLOT)    # drain in-flight handling spans
+    finally:
+        svc.stop()
+        obs_trace.disarm()
+    q.put((idx, tracer.finished()))
+
+
+def test_two_process_tcp_dumps_stitch_into_one_trace():
+    """ISSUE 12 acceptance: two OS processes gossip over real TCP with
+    independently-counting tracers; stitching both dumps yields ONE
+    connected upload trace — single trace id, zero orphan parents,
+    the ``net.recv`` join intact across the process boundary."""
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(2)
+    q = ctx.Queue()
+    genesis_time = time.time() + 2.0
+    procs = [ctx.Process(target=_stitch_worker,
+                         args=(i, ports, q, genesis_time))
+             for i in range(2)]
+    for p in procs:
+        p.start()
+    dumps = dict(q.get(timeout=90) for _ in range(2))
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+
+    st = TraceStitcher()
+    st.add_dump("a", dumps[0])
+    st.add_dump("b", dumps[1])
+    uploads = [t for t in st.traces()
+               if any(s["name"] == "fleet.upload" for s in t["spans"])]
+    assert len(uploads) == 1, "the upload episode must be ONE trace"
+    [t] = uploads
+    assert t["trace_id"] == 101          # the SENDER's trace id
+    assert set(t["instances"]) == {"a", "b"}
+    by_uid = {s["uid"]: s for s in t["spans"]}
+    root_uid = next(u for u, s in by_uid.items()
+                    if s["name"] == "fleet.upload")
+    recvs = [s for s in t["spans"]
+             if s["name"] == "net.recv:tx" and s["instance"] == "b"]
+    assert recvs, "node b never handled the tx under a joined span"
+    # the cross-process edge survived stitching: b's recv span hangs
+    # off the sender's root, with the remote_parent mark intact
+    assert any(s["parent_uid"] == root_uid and s["remote_parent"]
+               for s in recvs)
+    # one CONNECTED trace: every span either is a root or resolves its
+    # parent inside the trace — zero orphans, zero truncations
+    assert t["truncated"] == []
+    for s in t["spans"]:
+        assert s["parent_uid"] in by_uid or s["parent_uid"] is None
+        if s["parent_uid"] is None:
+            assert not s["remote_truncated"]

@@ -347,9 +347,11 @@ class TestIncidentTriggers:
         rec.note("slo", "transition", cls="verify", frm="ok", to="burning")
         (b,) = rep.bundles()
         assert set(b) == {"seq", "trigger", "key", "detail", "journal",
-                          "pinned", "metrics_delta", "snapshots", "faults",
-                          "context", "canon"}
+                          "pinned", "stitched", "metrics_delta",
+                          "snapshots", "faults", "context", "canon"}
         assert b["pinned"][0]["reasons"] == ["error"]
+        assert b["stitched"] == []      # no stitcher attached
+        assert "stitched" not in b["canon"]
         assert b["snapshots"]["flight"]["pins"] == 1
         assert [j["kind"] for j in b["journal"]] == ["transition"]
         json.dumps(b)       # must survive the RPC / --flight artifact path

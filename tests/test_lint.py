@@ -660,6 +660,36 @@ class TestSimDeterminism:
             assert lint(CLEAN_SIM, path).findings == []
         assert lint(DIRTY_SIM, "cess_tpu/obs/trace.py").findings == []
 
+    def test_fleet_plane_joins_the_family(self):
+        """ISSUE 12: the fleet plane's scrape rounds, straggler scans
+        and transition logs are count-sequenced into the replay
+        witness, so obs/fleet.py joins the determinism family next to
+        flight.py and incident.py — and the clean twin stays
+        silent."""
+        assert rules_at(lint(DIRTY_SIM, "cess_tpu/obs/fleet.py")) == \
+            {"sim-wallclock", "sim-entropy"}
+        assert lint(CLEAN_SIM, "cess_tpu/obs/fleet.py").findings == []
+
+    def test_fleet_module_scans_clean_under_every_family(self):
+        """ISSUE 12 satellite: the shipped obs/fleet.py passes
+        trace-safety, lock-discipline, span-balance AND the sim
+        determinism family with zero suppressions; the dirty twins
+        prove each family really fires at that path, and the baseline
+        stays empty."""
+        for dirty, rule in ((DIRTY_TRACE, "trace-print"),
+                            (DIRTY_LOCK, "lock-unguarded-write"),
+                            (DIRTY_SPAN, "span-balance"),
+                            (DIRTY_SIM, "sim-wallclock")):
+            assert rule in rules_at(
+                lint(dirty, "cess_tpu/obs/fleet.py")), rule
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "obs", "fleet.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_retention_modules_scan_clean(self):
         """ISSUE 9 satellite: the shipped retention layer passes its
         own determinism family (plus every other applicable rule)

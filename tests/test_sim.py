@@ -259,6 +259,22 @@ def _assert_scenario_behavior(name, report):
         assert report.uploads_active >= 1
     elif name == "partition_heal":
         assert max(f for f, _ in report.world.finalized_prefix()) > 0
+    elif name == "gateway_hotspot_fleet":
+        # ISSUE 12: the stripe partition's head lag must be VISIBLE at
+        # fleet level — both global views flipped to warn and recovered
+        # after the heal, in that order, in the deterministic log...
+        log = report.fleet.board.transition_log()
+        assert [(v, frm, to) for _c, v, frm, to, _r in log] == [
+            ("worst", "ok", "warn"), ("quorum", "ok", "warn"),
+            ("worst", "warn", "ok"), ("quorum", "warn", "ok")]
+        # ...the MAD detector flagged the lagging nodes as stragglers
+        # and each NEW outlier produced exactly one incident bundle
+        # (edge-triggered), with the scrape rounds really federated
+        triggers = [b["trigger"] for b in report.reporter.bundles()]
+        assert triggers.count("fleet-outlier") >= 1
+        fed = report.fleet.federator.snapshot()
+        assert len(fed["instances"]) == report.world.n
+        assert fed["round"] >= 1
 
 
 @pytest.mark.parametrize("name", sorted(SCENARIOS))

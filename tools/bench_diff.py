@@ -23,6 +23,19 @@ the gate (new metrics appear every round by design).
     python tools/bench_diff.py BENCH_r06.json --against BENCH_r05.json
     python tools/bench_diff.py current.jsonl --threshold 5 --json
 
+``--history`` switches from the two-round gate to the FULL trajectory:
+every checked-in round (or the explicit records given, oldest first)
+rendered as one per-metric table, with plateau detection — a metric
+that moved less than ``--plateau-tol`` percent per round for >= 3
+consecutive rounds is flagged PLATEAU (the optimization stalled), and
+a 2-round flat stretch that reaches the newest round is noted as an
+ongoing trailing plateau (the stall may just be starting — the r4->r5
+~64 GiB/s codec ceiling shows up exactly this way). History mode is
+informational: it always exits 0 unless a record fails to load.
+
+    python tools/bench_diff.py --history               # all BENCH_r*.json
+    python tools/bench_diff.py --history a.json b.json c.json --json
+
 Exit codes: 0 ok, 1 regression(s) past threshold, 2 usage/load error.
 """
 from __future__ import annotations
@@ -137,23 +150,135 @@ def diff(prev: dict[str, float], cur: dict[str, float],
             "regressions": [r["metric"] for r in regressions]}
 
 
+def plateau_runs(values: list, tol_pct: float) -> list[tuple[int, int]]:
+    """Maximal runs of consecutive rounds where the metric moved by at
+    most ``tol_pct`` percent per step — [start, end] index pairs, only
+    runs covering >= 2 rounds. ``None`` (metric absent that round) and
+    a zero previous value both break the run."""
+    runs = []
+    start = None
+    for i in range(1, len(values)):
+        p, c = values[i - 1], values[i]
+        flat = (p is not None and c is not None and p != 0
+                and abs(100.0 * (c - p) / p) <= tol_pct)
+        if flat:
+            if start is None:
+                start = i - 1
+        elif start is not None:
+            runs.append((start, i - 1))
+            start = None
+    if start is not None:
+        runs.append((start, len(values) - 1))
+    return runs
+
+
+def history(records: list[tuple[str, dict[str, float]]],
+            tol_pct: float) -> dict:
+    """Full per-metric trajectory over ``records`` (oldest first), with
+    plateau annotations. A run of >= 3 flat rounds flags the metric as
+    plateaued; a flat run that reaches the newest round is additionally
+    marked ongoing (>= 2 rounds is enough to note it — it may be a
+    plateau in the making)."""
+    labels = [label for label, _ in records]
+    metrics: dict[str, dict] = {}
+    flagged = []
+    for name in sorted({m for _, rec in records for m in rec}):
+        values = [rec.get(name) for _, rec in records]
+        plateaus = []
+        for start, end in plateau_runs(values, tol_pct):
+            n = end - start + 1
+            ongoing = end == len(values) - 1
+            if n >= 3 or ongoing:
+                plateaus.append({"start": labels[start],
+                                 "end": labels[end], "rounds": n,
+                                 "ongoing": ongoing})
+        if any(p["rounds"] >= 3 for p in plateaus):
+            flagged.append(name)
+        metrics[name] = {"values": values, "plateaus": plateaus}
+    return {"rounds": labels, "plateau_tol_pct": tol_pct,
+            "metrics": metrics, "flagged": flagged}
+
+
+def _label_of(path: str) -> str:
+    rnd = round_of(path)
+    return f"r{rnd:02d}" if rnd >= 0 else os.path.basename(path)
+
+
+def _render_history(report: dict, out) -> None:
+    labels = report["rounds"]
+    print(f"bench_diff history: {labels[0]} -> {labels[-1]} "
+          f"({len(labels)} rounds, plateau tol "
+          f"{report['plateau_tol_pct']:g}%)", file=out)
+    print("  " + f"{'metric':45s}"
+          + "".join(f"{lb:>12s}" for lb in labels), file=out)
+    for name, row in report["metrics"].items():
+        cells = "".join("{:>12}".format("-" if v is None else
+                                        f"{v:g}")
+                        for v in row["values"])
+        print(f"  {name:45s}{cells}", file=out)
+        for p in row["plateaus"]:
+            kind = "PLATEAU" if p["rounds"] >= 3 \
+                else "trailing plateau"
+            tail = " (ongoing)" if p["ongoing"] else ""
+            print(f"    ^ {kind}: {p['start']}..{p['end']} "
+                  f"({p['rounds']} rounds){tail}", file=out)
+    if report["flagged"]:
+        print(f"PLATEAU: {len(report['flagged'])} metric(s) flat for "
+              f">= 3 rounds: " + ", ".join(report["flagged"]),
+              file=out)
+    else:
+        print("no >= 3-round plateaus", file=out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="bench_diff",
                                  description=__doc__.splitlines()[0])
-    ap.add_argument("current", nargs="?", default=None,
-                    help="current record (default: newest BENCH_r*.json)")
+    ap.add_argument("records", nargs="*", default=[],
+                    help="current record (default: newest "
+                         "BENCH_r*.json); with --history, the full "
+                         "record list oldest first (default: every "
+                         "BENCH_r*.json)")
     ap.add_argument("--against", default=None,
                     help="previous record (default: the round before "
                          "the current one)")
     ap.add_argument("--threshold", type=float, default=10.0,
                     help="regression percentage that fails the gate "
                          "(default 10)")
+    ap.add_argument("--history", action="store_true",
+                    help="render the full per-metric trajectory over "
+                         "every round and flag plateaus instead of "
+                         "gating two rounds")
+    ap.add_argument("--plateau-tol", type=float, default=2.0,
+                    metavar="PCT",
+                    help="per-round move (percent) under which a "
+                         "metric counts as flat (default 2)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
 
     rounds = newest_rounds()
-    current = args.current
+    if args.history:
+        paths = args.records or sorted(rounds, key=round_of)
+        if len(paths) < 2:
+            print("history needs at least two records", file=sys.stderr)
+            return 2
+        try:
+            records = [(_label_of(p), load_record(p)[0])
+                       for p in paths]
+        except (OSError, ValueError) as e:
+            print(f"bench_diff: {e}", file=sys.stderr)
+            return 2
+        report = history(records, args.plateau_tol)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            _render_history(report, sys.stdout)
+        return 0
+    if len(args.records) > 1:
+        print("more than one record needs --history (or pass the "
+              "previous one via --against)", file=sys.stderr)
+        return 2
+    current = args.records[0] if args.records else None
     against = args.against
     if current is None:
         if not rounds:

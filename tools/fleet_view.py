@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Render a ``cess_fleetStatus`` snapshot as a human fleet dashboard.
+
+Input: a JSON file holding one ``cess_fleetStatus`` payload (what the
+RPC returns when a node runs with ``--fleet``, or
+``FleetPlane.snapshot()`` dumped from a sim run). Stdlib only;
+read-only.
+
+    python tools/fleet_view.py fleet_status.json
+    python tools/fleet_view.py fleet_status.json --metrics 30
+
+Layout mirrors how the plane is built: the global SLO board first
+(worst-of and quorum views per class, plus the per-node states they
+derive from), then straggler state, then the stitched cross-node
+traces, then the federated metric view (gauges and clamped counters,
+truncated to ``--metrics`` series; merged histograms always shown).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_STATE_MARK = {"ok": " ", "warn": "!", "burning": "*"}
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) or "federation" not in payload \
+            or "board" not in payload:
+        raise SystemExit(f"{path}: not a cess_fleetStatus payload")
+    return payload
+
+
+def _render_board(board: dict, out) -> None:
+    classes = board.get("classes", {})
+    print(f"global SLO board (round {board.get('round', 0)}, "
+          f"{len(classes)} class(es)):", file=out)
+    for cls in sorted(classes):
+        view = classes[cls]
+        p99 = view.get("p99_s")
+        p99_txt = "-" if p99 is None else f"{p99 * 1e3:.2f} ms"
+        print(f"  {cls:<12} worst={view['worst']:<8} "
+              f"quorum={view['quorum']:<8} p99={p99_txt}", file=out)
+        nodes = view.get("nodes", {})
+        for inst in sorted(nodes):
+            mark = _STATE_MARK.get(nodes[inst], "?")
+            print(f"    [{mark}] {inst:<10} {nodes[inst]}", file=out)
+    transitions = board.get("transitions", [])
+    print(f"  transition log ({len(transitions)} entries):", file=out)
+    for cls, view, old, new, rnd in transitions:
+        print(f"    round {rnd:>4}  {cls:<12} {view:<6} "
+              f"{old} -> {new}", file=out)
+
+
+def _render_stragglers(stragglers: dict, out) -> None:
+    outliers = stragglers.get("outliers", [])
+    print(f"stragglers: {stragglers.get('scans', 0)} scan(s) over "
+          f"{stragglers.get('windows', 0)} window(s), "
+          f"{len(outliers)} current outlier(s)", file=out)
+    for key in outliers:
+        print(f"    OUTLIER {key}", file=out)
+
+
+def _render_stitch(stitch: dict, out) -> None:
+    traces = stitch.get("traces", [])
+    print(f"stitched traces: {stitch.get('spans', 0)} span(s) from "
+          f"{stitch.get('dumps', 0)} dump(s), {len(traces)} trace(s):",
+          file=out)
+    for t in traces:
+        trunc = f" truncated={t['truncated']}" if t.get("truncated") \
+            else ""
+        print(f"  trace {t['trace_id']}: {t['n_spans']} spans across "
+              f"{','.join(t['instances'])} "
+              f"roots={','.join(t['roots']) or '-'}{trunc}", file=out)
+
+
+def _render_federation(fed: dict, limit: int, out) -> None:
+    insts = fed.get("instances", [])
+    counters = fed.get("counters", {})
+    gauges = fed.get("gauges", {})
+    hists = fed.get("histograms", {})
+    print(f"federation (round {fed.get('round', 0)}): "
+          f"{len(insts)} instance(s): {','.join(insts)}", file=out)
+    for title, series in (("counters", counters), ("gauges", gauges)):
+        keys = sorted(series)
+        shown = keys[:limit]
+        print(f"  {title} ({len(keys)} series"
+              + (f", first {len(shown)}" if len(shown) < len(keys)
+                 else "") + "):", file=out)
+        for k in shown:
+            print(f"    {k:<64} {series[k]:g}", file=out)
+    print(f"  merged histograms ({len(hists)}):", file=out)
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"    {k:<48} count={h['count']} sum={h['sum']:g}",
+              file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a cess_fleetStatus snapshot as a "
+                    "human-readable fleet dashboard")
+    ap.add_argument("path", help="cess_fleetStatus JSON payload")
+    ap.add_argument("--metrics", type=int, default=20, metavar="N",
+                    help="federated series shown per kind "
+                         "(default 20)")
+    args = ap.parse_args(argv)
+    snap = _load(args.path)
+    out = sys.stdout
+    print(f"fleet plane @ {snap.get('instance', '?')}: "
+          f"{snap.get('rounds', 0)} scrape round(s)", file=out)
+    print(file=out)
+    _render_board(snap.get("board", {}), out)
+    print(file=out)
+    _render_stragglers(snap.get("stragglers", {}), out)
+    print(file=out)
+    _render_stitch(snap.get("stitch", {}), out)
+    print(file=out)
+    _render_federation(snap.get("federation", {}), args.metrics, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
