@@ -765,12 +765,17 @@ class NodeService:
             # attribute load + None check per slot.
             plane = getattr(self.node, "fleet", None)
             if plane is not None and slot % FLEET_EVERY == 0:
-                with self.lock:
-                    frame = plane.self_frame()
-                if frame is not None:
-                    self.broadcast(("fleet", frame), mark_seen=False)
-                    plane.ingest_frame(frame)
-                plane.seal_round()
+                try:
+                    with self.lock:
+                        frame = plane.self_frame()
+                    if frame is not None:
+                        self.broadcast(("fleet", frame), mark_seen=False)
+                        plane.ingest_frame(frame)
+                    plane.seal_round()
+                except Exception as e:   # noqa: BLE001 — peer frames
+                    # must never kill authoring (ingest validates, but
+                    # the observability plane is best-effort anyway)
+                    self._record_error(f"fleet round slot {slot}: {e!r}")
             # finality healing: gossip is fire-and-forget and sync
             # re-fetches blocks, never votes — a vote relayed into a
             # partially-formed mesh is lost forever, which stalls

@@ -269,16 +269,26 @@ class MetricFederator:
         """Fleet-wide :class:`~cess_tpu.obs.prom.Histogram` for one
         family across every instance (None when the family is unknown
         or no instance's buckets parse). Merge order is sorted by
-        instance — deterministic, and merge is commutative anyway."""
+        instance — deterministic, and merge is commutative anyway.
+        Instances whose bucket grids disagree (version skew, a hostile
+        peer) cannot merge — only the grid MOST instances agree on is
+        merged (ties break to the smaller grid: deterministic), the
+        rest are skipped, never fatal."""
         with self._mu:
             per = dict(self._hists.get((name, tuple(sorted(labels))), {}))
-        merged = None
+        grids: dict = {}        # bounds tuple -> [Histogram...]
         for inst in sorted(per):
             buckets, total_sum = per[inst]
             try:
                 h = prom.Histogram.from_cumulative(buckets, total_sum)
             except ValueError:
                 continue            # malformed node scrape: skip it
+            grids.setdefault(tuple(h.bounds), []).append(h)
+        if not grids:
+            return None
+        majority = max(sorted(grids), key=lambda b: len(grids[b]))
+        merged = None
+        for h in grids[majority]:
             merged = h if merged is None else merged.merge(h)
         return merged
 
@@ -323,10 +333,15 @@ class MetricFederator:
                 "histograms": out_hists}
 
     def render(self) -> str:
-        """The federated exposition: every instance's series re-emitted
-        with the ``instance`` label, one TYPE line per family, sorted —
-        what a fleet-level scrape endpoint would serve."""
+        """The federated exposition: every instance's counter and gauge
+        series re-emitted with the ``instance`` label, histogram
+        families re-emitted MERGED across instances (one fleet-wide
+        grid per family — per-instance vectors live in ``snapshot``),
+        one TYPE line per family, sorted — what a fleet-level scrape
+        endpoint would serve."""
         snap = self.snapshot()
+        with self._mu:
+            hist_keys = sorted(self._hists)
         lines = []
         declared: set[str] = set()
         for key in sorted(snap["counters"]):
@@ -335,6 +350,14 @@ class MetricFederator:
         for key in sorted(snap["gauges"]):
             self._declare(key, "gauge", declared, lines)
             lines.append(f"{key} {snap['gauges'][key]}")
+        for name, labels in hist_keys:
+            merged = self.merged_histogram(name, labels)
+            if merged is None:
+                continue
+            lines.extend(prom.render_histogram(
+                name, merged, labels=dict(labels),
+                type_line=name not in declared))
+            declared.add(name)
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -422,10 +445,18 @@ class FleetBoard:
             self._round += 1
             rnd = self._round
             for inst in sorted(snapshots):
-                targets = (snapshots[inst] or {}).get("targets", {})
+                snap = snapshots[inst]
+                targets = snap.get("targets") \
+                    if isinstance(snap, dict) else None
+                if not isinstance(targets, dict):
+                    targets = {}
+                # per-class entries that are not dicts are skipped, not
+                # fatal — a malformed snapshot must not wedge the board
                 self._nodes[str(inst)] = {
                     str(cls): str(d.get("state", "ok"))
-                    for cls, d in sorted(targets.items())}
+                    for cls, d in sorted(targets.items(),
+                                         key=lambda kv: str(kv[0]))
+                    if isinstance(d, dict)}
             if p99_s:
                 for cls in sorted(p99_s):
                     self._p99[str(cls)] = round(float(p99_s[cls]), 9)
@@ -529,7 +560,13 @@ class TraceStitcher:
     - a local parent resolves within the same instance;
     - a ``remote_parent`` reference resolves against OTHER instances'
       spans carrying the same ``(trace_id, span_id)`` — the sender's
-      side of a PR-5 net envelope hop;
+      side of a PR-5 net envelope hop. Span ids are per-tracer
+      counters, so MULTIPLE other instances can match within one
+      trace; resolution picks the lexicographically-first instance
+      (deterministic) and marks the span ``ambiguous_parent`` so a
+      postmortem reader knows the sender attribution is a guess, not
+      a fact (exact resolution needs the sender identity in the net
+      envelope — a wire change deferred to the multi-host PR);
     - a parent no retained dump contains is marked
       ``remote_truncated`` (ring-buffer eviction, a crashed node) and
       the span becomes a visible truncation point, never a silent
@@ -587,6 +624,7 @@ class TraceStitcher:
             s["instance"] = inst
             s["uid"] = f"{inst}/{sid}"
             s["remote_truncated"] = False
+            s["ambiguous_parent"] = False
             parent = s.get("parent_id") or 0
             if not parent:
                 s["parent_uid"] = None
@@ -595,6 +633,9 @@ class TraceStitcher:
                                 if i != inst)
                 if others:
                     s["parent_uid"] = f"{others[0]}/{parent}"
+                    # >1 candidate sender: per-tracer span ids collide
+                    # across instances — flag, don't pick silently
+                    s["ambiguous_parent"] = len(others) > 1
                 elif (inst, parent) in local:
                     # loopback hop: the remote parent is local after all
                     s["parent_uid"] = f"{inst}/{parent}"
@@ -621,6 +662,8 @@ class TraceStitcher:
                           and not s["remote_truncated"]],
                 "truncated": [s["uid"] for s in tr
                               if s["remote_truncated"]],
+                "ambiguous": [s["uid"] for s in tr
+                              if s["ambiguous_parent"]],
             })
         return out
 
@@ -633,7 +676,7 @@ class TraceStitcher:
             out.append((t["trace_id"], tuple(
                 (s["uid"], s.get("name", ""), s.get("sys", ""),
                  s["parent_uid"] or "", bool(s.get("remote_parent")),
-                 s["remote_truncated"])
+                 s["remote_truncated"], s["ambiguous_parent"])
                 for s in t["spans"])))
         return tuple(out)
 
@@ -651,6 +694,7 @@ class TraceStitcher:
                 "n_spans": len(t["spans"]),
                 "roots": t["roots"],
                 "truncated": t["truncated"],
+                "ambiguous": t["ambiguous"],
             } for t in traces],
         }
 
@@ -680,20 +724,34 @@ class StragglerDetector:
     ``fleet.outlier`` span when a node BECOMES an outlier, nothing
     while it stays one, re-armed once it rejoins the pack.
 
-    Determinism: windows and scans are count-sequenced; scans iterate
-    instances and metrics sorted. No wallclock anywhere."""
+    Staleness: a window with no fresh sample for ``stale_scans``
+    consecutive scans belongs to a node that stopped reporting
+    (crashed, partitioned) — it is evicted so dead nodes neither skew
+    the fleet median nor stay flagged forever; and any flag a scan
+    can no longer derive (the window evicted, the metric's reporting
+    count below ``min_nodes``) is dropped with it. If the evidence
+    returns, the edge trigger re-fires.
+
+    Determinism: windows, scans and staleness are count-sequenced;
+    scans iterate instances and metrics sorted. No wallclock
+    anywhere."""
 
     def __init__(self, *, window: int = 16, k: float = 4.0,
-                 min_nodes: int = 4, min_mad: float = 1e-9):
-        if window < 1 or min_nodes < 2 or k <= 0 or min_mad <= 0:
+                 min_nodes: int = 4, min_mad: float = 1e-9,
+                 stale_scans: int = 8):
+        if window < 1 or min_nodes < 2 or k <= 0 or min_mad <= 0 \
+                or stale_scans < 1:
             raise ValueError("invalid straggler detector bounds")
         self.window = int(window)
         self.k = float(k)
         self.min_nodes = int(min_nodes)
         self.min_mad = float(min_mad)
+        self.stale_scans = int(stale_scans)
         self._mu = threading.Lock()
         self._windows: dict = {}    # (instance, metric) -> deque
         self._flagged: dict = {}    # (instance, metric) -> bool
+        self._dirty: set = set()    # keys observed since the last scan
+        self._last_obs: dict = {}   # key -> scan seq last seen fresh
         self._scans = 0
 
     def observe(self, instance: str, metric: str, value: float) -> None:
@@ -704,6 +762,7 @@ class StragglerDetector:
                 dq = self._windows[key] = collections.deque(
                     maxlen=self.window)
             dq.append(float(value))
+            self._dirty.add(key)
 
     def scan(self) -> list:
         """One count-sequenced outlier scan; returns the NEW outliers
@@ -713,11 +772,21 @@ class StragglerDetector:
         with self._mu:
             self._scans += 1
             seq = self._scans
+            for key in self._dirty:
+                self._last_obs[key] = seq
+            self._dirty.clear()
+            stale = [k for k in self._windows
+                     if seq - self._last_obs.get(k, seq)
+                     >= self.stale_scans]
+            for key in stale:
+                del self._windows[key]
+                self._last_obs.pop(key, None)
             by_metric: dict = {}
             for (inst, metric), dq in sorted(self._windows.items()):
                 if dq:
                     by_metric.setdefault(metric, []).append(
                         (inst, _median(list(dq))))
+            evaluated: set = set()
             for metric in sorted(by_metric):
                 rows = by_metric[metric]
                 if len(rows) < self.min_nodes:
@@ -728,11 +797,18 @@ class StragglerDetector:
                 for inst, v in rows:
                     is_out = abs(v - med) > self.k * mad
                     key = (inst, metric)
+                    evaluated.add(key)
                     if is_out and not self._flagged.get(key, False):
                         fired.append((inst, metric, round(v, 9),
                                       round(med, 9), round(mad, 9),
                                       seq))
                     self._flagged[key] = is_out
+            # a flag this scan could NOT re-derive (the metric fell
+            # below min_nodes, the instance went silent) is stale —
+            # drop it so snapshot()['outliers'] reflects only current
+            # state; if the evidence returns, the edge re-fires
+            self._flagged = {k: v for k, v in self._flagged.items()
+                             if k in evaluated}
         for inst, metric, v, med, mad, sq in fired:
             with _trace.span("fleet.outlier", sys="fleet",
                              instance=inst, metric=metric):
@@ -823,6 +899,15 @@ class FleetPlane:
             except (TypeError, ValueError):
                 return
             if not isinstance(slo, dict):
+                return
+            # nested shape too: "targets" must be a dict of dicts —
+            # ('{"targets": 123}', '{"targets": {"c": "burning"}}')
+            # must not reach the FleetBoard and raise out of a seal
+            targets = slo.get("targets")
+            if targets is not None and (
+                    not isinstance(targets, dict)
+                    or any(not isinstance(d, dict)
+                           for d in targets.values())):
                 return
         self.ingest(inst, exposition=expo or None, slo=slo)
 

@@ -197,6 +197,41 @@ class TestMetricFederator:
         assert ("cess_block_height", (("instance", "a"),), 1.0) \
             in p["samples"]
 
+    def test_render_reemits_merged_histogram_families(self):
+        # a downstream scraper of the federated exposition must see
+        # the latency histograms, not just counters and gauges
+        fed = MetricFederator()
+        fed.scrape_round({"a": _HIST.format(a=1, b=2, s=1.0),
+                          "b": _HIST.format(a=0, b=1, s=0.5)})
+        out = fed.render()
+        assert out.count("# TYPE cess_upload_seconds histogram") == 1
+        p = parse_exposition(out)
+        assert ("cess_upload_seconds_count", (), 3.0) in p["samples"]
+        assert ("cess_upload_seconds_bucket", (("le", "0.5"),), 1.0) \
+            in p["samples"]
+        assert ("cess_upload_seconds_bucket", (("le", "+Inf"),), 3.0) \
+            in p["samples"]
+
+    def test_mismatched_bucket_grids_merge_majority_never_raise(self):
+        # a version-skewed (or hostile) peer exposing the same family
+        # on a different bucket grid cannot merge — the grid most
+        # instances agree on wins and the rest are skipped, instead of
+        # ValueError escaping into snapshot()/seal_round()
+        alien = ("# TYPE cess_upload_seconds histogram\n"
+                 'cess_upload_seconds_bucket{le="0.25"} 1\n'
+                 'cess_upload_seconds_bucket{le="+Inf"} 1\n'
+                 "cess_upload_seconds_sum 0.1\n"
+                 "cess_upload_seconds_count 1\n")
+        fed = MetricFederator()
+        fed.scrape_round({"a": alien,
+                          "b": _HIST.format(a=1, b=2, s=1.0),
+                          "c": _HIST.format(a=2, b=3, s=2.0)})
+        merged = fed.merged_histogram("cess_upload_seconds")
+        assert merged.count == 5        # b+c's grid; 'a' skipped
+        snap = fed.snapshot()           # must not raise
+        assert snap["histograms"]["cess_upload_seconds"]["count"] == 5
+        assert "cess_upload_seconds_count 5" in fed.render()
+
 
 # -- global SLO view ---------------------------------------------------------
 def _slo(state):
@@ -270,6 +305,21 @@ class TestFleetBoard:
         with pytest.raises(ValueError):
             FleetBoard(max_transitions=0)
 
+    def test_hostile_snapshot_shapes_cannot_wedge_the_board(self):
+        # scrape_round is fed from peer gossip via seal_round; a
+        # malformed snapshot must degrade to "nothing reported", not
+        # raise out of the author loop
+        board = FleetBoard()
+        board.scrape_round({"n0": "junk",
+                            "n1": {"targets": 123},
+                            "n2": {"targets": {"upload": "burning"}},
+                            "n3": {"targets": {"upload":
+                                               {"state": "warn"}}},
+                            "n4": None})
+        assert board.state("upload", view="worst") == "warn"
+        assert board.snapshot()["classes"]["upload"]["nodes"] == {
+            "n3": "warn"}
+
 
 # -- cross-node trace stitching ----------------------------------------------
 def _span(sid, tid, parent=0, remote=False, name="s", inst_extra=()):
@@ -319,6 +369,24 @@ class TestTraceStitcher:
         assert nine["truncated"] == ["a/3", "b/5"]
         assert all(s["parent_uid"] is None for s in nine["spans"])
         assert nine["roots"] == []    # truncation points are not roots
+
+    def test_multi_candidate_remote_parent_flagged_ambiguous(self):
+        # span ids are per-tracer counters, so two senders can both
+        # hold (trace 9, span 2): resolution stays deterministic
+        # (lexicographically-first instance) but must SAY it guessed
+        st = TraceStitcher()
+        st.add_dump("a", [_span(2, 9, name="send")])
+        st.add_dump("b", [_span(2, 9, name="send")])
+        st.add_dump("c", [_span(1, 9, parent=2, remote=True,
+                                name="net.recv:tx")])
+        [t] = st.traces()
+        by_uid = {s["uid"]: s for s in t["spans"]}
+        assert by_uid["c/1"]["parent_uid"] == "a/2"
+        assert by_uid["c/1"]["ambiguous_parent"] is True
+        assert t["ambiguous"] == ["c/1"]
+        # a single-candidate hop stays unflagged
+        assert by_uid["a/2"]["ambiguous_parent"] is False
+        assert st.snapshot()["traces"][0]["ambiguous"] == ["c/1"]
 
     def test_witness_is_structure_only(self):
         st = TraceStitcher()
@@ -388,9 +456,28 @@ class TestStragglerDetector:
 
     def test_bounds_validated(self):
         for kw in ({"window": 0}, {"min_nodes": 1}, {"k": 0},
-                   {"min_mad": 0}):
+                   {"min_mad": 0}, {"stale_scans": 0}):
             with pytest.raises(ValueError):
                 StragglerDetector(**kw)
+
+    def test_crashed_nodes_decay_and_their_flags_clear(self):
+        det = StragglerDetector(window=1, k=4.0, min_nodes=4,
+                                stale_scans=1)
+        _feed(det, {"n0": 1.0, "n1": 1.0, "n2": 1.0, "n3": 50.0})
+        assert det.scan()
+        assert det.snapshot()["outliers"] == ["n3/lag"]
+        # n2 and n3 crash: nothing fresh from them for stale_scans
+        # scans, so their windows evict, the metric drops below
+        # min_nodes, and the n3 flag clears instead of listing a dead
+        # node as an outlier forever
+        _feed(det, {"n0": 1.0, "n1": 1.0})
+        det.scan()
+        assert det.snapshot()["outliers"] == []
+        assert det.snapshot()["windows"] == 2
+        # the evidence returning re-arms the edge trigger
+        for _ in range(2):
+            _feed(det, {"n0": 1.0, "n1": 1.0, "n2": 1.0, "n3": 50.0})
+        assert [(f[0], f[1]) for f in det.scan()] == [("n3", "lag")]
 
     def test_outlier_note_is_the_incident_trigger(self):
         rec = flight.FlightRecorder(b"outlier-inc")
@@ -411,10 +498,24 @@ class TestFleetPlane:
         plane = FleetPlane("self")
         for frame in (None, 42, ("a",), ("a", "x", "y", "z"),
                       (7, "expo", ""), ("a", 7, ""),
-                      ("a", "expo", "{not json"), ("a", "expo", "[1]")):
+                      ("a", "expo", "{not json"), ("a", "expo", "[1]"),
+                      # nested shape: targets must be a dict of dicts
+                      ("a", "expo", '{"targets": 123}'),
+                      ("a", "expo", '{"targets": {"c": "burning"}}')):
             plane.ingest_frame(frame)
         plane.seal_round()
         assert plane.federator.snapshot()["instances"] == []
+
+    def test_hostile_slo_shapes_cannot_kill_a_seal(self):
+        # the author loop calls seal_round; a peer feeding malformed
+        # SLO snapshots must not be able to raise out of it and kill
+        # the block-authoring thread
+        plane = FleetPlane("self")
+        plane.ingest("p1", slo={"targets": 123})
+        plane.ingest("p2", slo={"targets": {"u": "burning"}})
+        plane.ingest("p3", slo="junk")
+        plane.seal_round()
+        assert plane.board.snapshot()["classes"] == {}
 
     def test_tick_scrapes_self_and_peers(self):
         plane = FleetPlane("self", latency_families={

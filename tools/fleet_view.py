@@ -71,9 +71,12 @@ def _render_stitch(stitch: dict, out) -> None:
     for t in traces:
         trunc = f" truncated={t['truncated']}" if t.get("truncated") \
             else ""
+        ambig = f" ambiguous={t['ambiguous']}" if t.get("ambiguous") \
+            else ""
         print(f"  trace {t['trace_id']}: {t['n_spans']} spans across "
               f"{','.join(t['instances'])} "
-              f"roots={','.join(t['roots']) or '-'}{trunc}", file=out)
+              f"roots={','.join(t['roots']) or '-'}{trunc}{ambig}",
+              file=out)
 
 
 def _render_federation(fed: dict, limit: int, out) -> None:
