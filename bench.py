@@ -325,7 +325,7 @@ def bench_repair_warm(jnp, jax, frag_size, reps):
     return (min(windows), float(np.median(lat_all)), cold_ms)
 
 
-def bench_stream(jnp, jax, batch, n_segments, seg_size):
+def bench_stream(jnp, jax, batch, n_segments, seg_size, engine=None):
     """stream_encode_tag_GiBps: end-to-end throughput timed FROM HOST
     BYTES to device tags — the honest number for the OSS-gateway
     ingest workload, where every earlier metric was device-resident.
@@ -345,12 +345,13 @@ def bench_stream(jnp, jax, batch, n_segments, seg_size):
     # warm the fused program (shared jit cache) outside the timed run
     for _ in StreamingIngest(pipe, batch).run(segs[:batch]):
         pass
-    ing = StreamingIngest(pipe, batch)
+    ing = StreamingIngest(pipe, batch, engine=engine)
     t0 = time.perf_counter()
     for _ in ing.run(segs):
         pass
     dt = time.perf_counter() - t0
     st = ing.stats.snapshot()
+    ing.detach()
     return n_segments * seg_size / 2**30 / dt, st
 
 
@@ -797,11 +798,11 @@ def main() -> None:
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
                          "pool,stream,degraded,traceov,adaptive,"
-                         "encode,sim,fleet")
+                         "encode,sim,fleet,profile")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "pool", "stream",
              "degraded", "traceov", "adaptive", "encode", "sim",
-             "fleet"}
+             "fleet", "profile"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -1032,6 +1033,45 @@ def main() -> None:
                     "negative) mean the hooks are free; "
                     "flight_overhead_frac adds tail-sampled retention "
                     "(obs/flight.py) on top of the armed tracer")
+
+    if "profile" in which:
+        # the profiling-cost pin (ISSUE 13): the SAME streamed
+        # from-host-bytes run, once with no engine attached (every
+        # profile seam is one attribute load + None check) and once
+        # attached to an engine carrying an armed ProfilePlane; the
+        # delta is what continuous per-batch attribution costs the
+        # hottest instrumented path. Recorded every round so an
+        # accidentally-expensive hook can never hide (--smoke asserts
+        # the fraction finite; the disarmed-path zero cost itself is
+        # pinned in tests/test_profile.py).
+        from cess_tpu.obs.profile import ProfilePlane
+        from cess_tpu.serve import make_engine
+
+        v_off, _ = bench_stream(jnp, jax, stream_batch, stream_n, seg)
+        plane = ProfilePlane()
+        eng = make_engine(4, 8, rs_backend="jax", profile=plane)
+        try:
+            v_on, _ = bench_stream(jnp, jax, stream_batch, stream_n,
+                                   seg, engine=eng)
+        finally:
+            eng.close()
+        frac = (v_off - v_on) / v_off
+        if _ASSERT_FINITE:
+            assert np.isfinite(frac), \
+                f"profile_overhead_frac produced {frac!r}"
+        pads = plane.pads.total()
+        emit("stream_encode_tag_profiled_GiBps", v_on, "GiB/s",
+             v_on / 12.0,
+             unprofiled_GiBps=round(v_off, 3),
+             profile_overhead_frac=round(frac, 4),
+             observations=plane.ops.observations(),
+             pad_rows=pads["padded"], served_rows=pads["served"],
+             method="streamed from-host-bytes run feeding an armed "
+                    "ProfilePlane (cess_tpu/obs/profile.py) through "
+                    "the attached engine; profile_overhead_frac = "
+                    "(unprofiled - profiled)/unprofiled over "
+                    "back-to-back runs — noise-level values (incl. "
+                    "slightly negative) mean the seams are free")
 
     if "adaptive" in which:
         # sustained mixed encode+verify at a fixed verify p99 target,

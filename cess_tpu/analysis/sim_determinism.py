@@ -15,12 +15,15 @@ would flake instead of fail. These rules make the contract static:
                   the world seed (the ``_u64`` idiom)
 
 The family also covers the flight recorder's retention-decision code
-(obs/flight.py + obs/incident.py, ISSUE 9) and the fleet plane
-(obs/fleet.py, ISSUE 12): "same seed retains the same traces,
-bundles the same incidents and federates the same fleet witness" is
-the identical replay contract, so a wall-clock read or entropy draw
-in a pin decision or a scrape round is the same class of bug as one
-in a sim world.
+(obs/flight.py + obs/incident.py, ISSUE 9), the fleet plane
+(obs/fleet.py, ISSUE 12) and the profile plane (obs/profile.py,
+ISSUE 13): "same seed retains the same traces, bundles the same
+incidents, federates the same fleet witness and profiles the same
+counters" is the identical replay contract, so a wall-clock read or
+entropy draw in a pin decision, a scrape round or a watchdog window
+is the same class of bug as one in a sim world. (The profile plane's
+timings are measured by its serve-layer CALLERS and passed in — the
+module itself never touches a clock.)
 """
 from __future__ import annotations
 
@@ -43,11 +46,13 @@ class _SimRule(Rule):
         parts = path_parts(path)
         if "sim" in parts:
             return True
-        # the retention layer and the fleet plane make seeded
-        # decisions under the same replay contract as sim worlds
+        # the retention layer, the fleet plane and the profile plane
+        # make seeded decisions under the same replay contract as sim
+        # worlds
         return "obs" in parts and parts[-1] in ("flight.py",
                                                 "incident.py",
-                                                "fleet.py")
+                                                "fleet.py",
+                                                "profile.py")
 
 
 @register

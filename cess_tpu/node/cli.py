@@ -189,6 +189,29 @@ def main(argv=None) -> int:
                          "GET /metrics and in cess_engineStats. "
                          "Results stay bit-identical to the "
                          "single-device engine. Requires --engine")
+    ap.add_argument("--profile", nargs="?", const="", default=None,
+                    metavar="BASELINE",
+                    help="arm the continuous-profiling plane "
+                         "(cess_tpu/obs/profile.py) on the --engine: "
+                         "per-(class, bucket, device) stage "
+                         "breakdowns (queue-wait/h2d/dispatch), the "
+                         "unified pad ledger (engine bucket padding + "
+                         "stream ragged tails in ONE account), "
+                         "program-cache compile events, and a "
+                         "bench-anchored PerfWatchdog that "
+                         "edge-triggers a perf-regression incident "
+                         "when live windowed throughput drops below a "
+                         "guard fraction of the checked-in bench "
+                         "record. BASELINE is a bench_diff "
+                         "--baseline-out artifact (bare --profile "
+                         "scans ./BENCH_r*.json for the newest "
+                         "round; no record found = profiling without "
+                         "judging). Served via the cess_profileDump "
+                         "RPC and cess_profile_* gauges on GET "
+                         "/metrics (render with tools/"
+                         "profile_view.py). Requires --engine; "
+                         "absent = zero-cost off (the --trace "
+                         "contract)")
     ap.add_argument("--resilience", default="off",
                     choices=["off", "on"],
                     help="attach the resilience layer "
@@ -333,6 +356,8 @@ def main(argv=None) -> int:
     engine = _make_cli_engine(args, spec)
     if engine is not None:
         nodes[0].engine = engine
+        if engine.profile is not None:
+            nodes[0].profile = engine.profile  # cess_profileDump RPC
     recorder, reporter = _arm_cli_flight(args, tracer, engine)
     if reporter is not None:
         nodes[0].flight = recorder
@@ -375,6 +400,7 @@ def main(argv=None) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_profile(engine)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)
@@ -506,6 +532,30 @@ def _finish_cli_fleet(plane, tracer) -> None:
           file=sys.stderr)
 
 
+def _finish_cli_profile(engine) -> None:
+    """Print the profile-plane summary: observation/pad/compile
+    totals and the watchdog verdict (render the full cess_profileDump
+    payload with tools/profile_view.py)."""
+    plane = getattr(engine, "profile", None)
+    if plane is None:
+        return
+    pads = plane.pads.total()
+    compiles = plane.compiles.snapshot()
+    wd = plane.watchdog
+    verdict = "watchdog off (no baseline)"
+    if wd is not None:
+        snap = wd.snapshot()
+        regressed = sorted(m for m, s in snap["states"].items()
+                           if s == "regressed")
+        verdict = (f"REGRESSED: {','.join(regressed)}" if regressed
+                   else f"ok ({len(snap['states'])} metric(s) "
+                        f"watched)")
+    print(f"profile plane: {plane.ops.observations()} observation(s), "
+          f"{pads['padded']} padded row(s) vs {pads['served']} served, "
+          f"{compiles['builds']} compile(s); {verdict}",
+          file=sys.stderr)
+
+
 def _make_cli_engine(args, spec):
     """--engine: build a submission engine over the chain's RS
     geometry with the requested ErasureCodec backend and attach it as
@@ -525,11 +575,14 @@ def _make_cli_engine(args, spec):
     burn-rate monitors + per-tenant accounting, and the adaptive
     batching/admission layer consuming it — cess_slo_*/cess_tenant_*/
     cess_adaptive_* counters on the same surfaces plus the
-    cess_sloStatus RPC."""
+    cess_sloStatus RPC. --profile mirrors it once more (ISSUE 13):
+    the continuous-profiling plane (obs/profile.py) — cess_profile_*
+    gauges plus the cess_profileDump RPC."""
     # getattr defaults: embedders hand-build minimal Namespaces
     slo_spec = getattr(args, "slo", None)
     adaptive = getattr(args, "adaptive", False)
     pool_spec = getattr(args, "pool", None)
+    profile_spec = getattr(args, "profile", None)
     if args.engine == "off":
         if args.resilience != "off":
             raise SystemExit("--resilience requires --engine "
@@ -543,6 +596,10 @@ def _make_cli_engine(args, spec):
         if pool_spec is not None:
             raise SystemExit("--pool requires --engine (it shards the "
                              "submission engine's dispatch)")
+        if profile_spec is not None:
+            raise SystemExit("--profile requires --engine (it "
+                             "accounts the submission engine's "
+                             "dispatches)")
         return None
     if pool_spec is not None and pool_spec < 0:
         raise SystemExit("--pool takes a non-negative lane count")
@@ -562,13 +619,25 @@ def _make_cli_engine(args, spec):
         from ..obs.slo import SloBoard, parse_targets
 
         slo = SloBoard(parse_targets(slo_spec))
+    profile = None
+    if profile_spec is not None:
+        from ..obs import profile as obs_profile
+
+        # --profile=PATH: a bench_diff --baseline-out artifact; bare
+        # --profile: the newest checked-in BENCH_r*.json round. No
+        # record found = an unanchored plane (profiling without
+        # judging) — the ledgers still fill, the watchdog stays inert.
+        baseline = (obs_profile.load_baseline(profile_spec)
+                    if profile_spec
+                    else obs_profile.latest_bench_baseline())
+        profile = obs_profile.ProfilePlane(baseline=baseline)
     k = max(spec.fragment_count - 1, 1)      # reference RS(k, 1) shape
     # --pool = all local devices; --pool=N = the first N lanes
     pool = None if pool_spec is None else (pool_spec or True)
     return make_engine(k, spec.fragment_count - k,
                        rs_backend=args.engine, resilience=resilience,
                        slo=slo, adaptive=True if adaptive else None,
-                       pool=pool)
+                       pool=pool, profile=profile)
 
 
 def _data_dir(args, spec) -> "str | None":
@@ -662,6 +731,8 @@ def _run_tcp_node(args, spec) -> int:
     engine = _make_cli_engine(args, spec)
     if engine is not None:
         node.engine = engine
+        if engine.profile is not None:
+            node.profile = engine.profile  # cess_profileDump RPC
     recorder, reporter = _arm_cli_flight(args, tracer, engine)
     if reporter is not None:
         node.flight = recorder
@@ -698,6 +769,7 @@ def _run_tcp_node(args, spec) -> int:
             rpc.stop()
         if engine is not None:
             engine.close()
+        _finish_cli_profile(engine)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)

@@ -37,6 +37,10 @@ Methods:
                       global SLO views, stitched cross-node traces,
                       straggler state; obs/fleet.py, armed via
                       node.cli --fleet)
+  cess_profileDump   (continuous-profiling plane: per-shape stage
+                      breakdowns, pad/compile ledgers, watchdog
+                      states + transitions; obs/profile.py, armed via
+                      node.cli --profile)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -351,6 +355,14 @@ class RpcServer:
             # traces and straggler scan state. Null when the node runs
             # without a fleet plane (node.cli --fleet).
             plane = getattr(node, "fleet", None)
+            return None if plane is None else plane.snapshot()
+        if method == "cess_profileDump":
+            # continuous-profiling plane (obs/profile.py): per-(class,
+            # bucket, device) stage breakdowns, the unified pad
+            # ledger, compile events and the bench-anchored watchdog
+            # state. Null when the node runs without a profile plane
+            # (node.cli --profile).
+            plane = getattr(node, "profile", None)
             return None if plane is None else plane.snapshot()
         if method == "cess_sloStatus":
             # SLO observability debug surface (obs/slo.py): per-class

@@ -20,6 +20,10 @@ trigger class       journal entry (subsystem, kind)
                     escaping the batcher / stream driver
 ``fleet-outlier``   ``("fleet", "outlier")`` — the fleet plane's MAD
                     straggler detector flagged a node (obs/fleet.py)
+``perf-regression`` ``("perf", "regression")`` with ``to ==
+                    "regressed"`` — the profile plane's bench-anchored
+                    watchdog (obs/profile.py); the bundle embeds the
+                    pad and compile ledgers
 ==================  ========================================================
 
 Each bundle is self-contained: the pinned traces, the journal tail,
@@ -58,7 +62,7 @@ from .trace import _json_safe
 # journal reacts to host-timed p99 estimates, so it is evidence, not
 # witness)
 _CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
-                        "finality", "flight", "fleet"))
+                        "finality", "flight", "fleet", "perf"))
 
 
 def _sanitize(value):
@@ -97,6 +101,10 @@ class IncidentReporter:
                    at trigger time) and canon gains its replay-stable
                    witness, so a multi-host incident's postmortem
                    holds ONE connected trace instead of N fragments.
+    profile:       optional obs/profile.py ProfilePlane — bundles gain
+                   a ``profile`` snapshot section (both ledgers);
+                   falls back to ``engine.profile`` when the engine
+                   carries one.
     context:       optional callable returning a dict merged into each
                    bundle — sim runs supply the scenario seed +
                    witness needed to replay the episode.
@@ -105,7 +113,8 @@ class IncidentReporter:
     """
 
     def __init__(self, recorder, *, engine=None, board=None, plan=None,
-                 stitcher=None, context=None, max_per_class: int = 4,
+                 stitcher=None, profile=None, context=None,
+                 max_per_class: int = 4,
                  max_bundles: int = 32, shed_storm: int = 8,
                  journal_tail: int = 64):
         if max_per_class < 1 or max_bundles < 1 or shed_storm < 1:
@@ -116,6 +125,8 @@ class IncidentReporter:
             else getattr(engine, "slo", None)
         self.plan = plan
         self.stitcher = stitcher
+        self.profile = profile if profile is not None \
+            else getattr(engine, "profile", None)
         self.context = context
         self.max_per_class = max_per_class
         self.shed_storm = shed_storm
@@ -169,6 +180,13 @@ class IncidentReporter:
                          key=f"{detail.get('instance')}:"
                              f"{detail.get('metric')}",
                          detail=detail)
+        elif subsystem == "perf" and kind == "regression":
+            # edge-triggered both ways by the watchdog; only the
+            # ok->regressed edge is an incident (recovery is good news)
+            if detail.get("to") != "regressed":
+                return
+            self.trigger("perf-regression",
+                         key=str(detail.get("metric")), detail=detail)
 
     # -- triggering ----------------------------------------------------------
     def trigger(self, cls: str, key: str, detail: dict) -> dict | None:
@@ -223,6 +241,13 @@ class IncidentReporter:
         admission = getattr(engine, "admission", None)
         if admission is not None:
             snapshots["admission"] = admission.snapshot()
+        profile = self.profile
+        if profile is not None:
+            # both ledgers (pads + compiles) ride every bundle — the
+            # perf-regression postmortem's "where did the time go".
+            # Evidence-side only: compile wall times are host timings
+            # and must never reach canon
+            snapshots["profile"] = profile.ledgers()
         stitcher = self.stitcher
         stitched = [] if stitcher is None else stitcher.traces()
         with self._mu:

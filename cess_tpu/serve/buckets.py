@@ -22,6 +22,7 @@ tests/test_serve.py pin this.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 
@@ -69,6 +70,12 @@ class ProgramCache:
             collections.OrderedDict()
         self._stats = stats
         self.capacity = capacity
+        # continuous profiling (obs/profile.py, opt-in): the engine
+        # arms this with its ProfilePlane so every cache MISS lands in
+        # the CompileLedger with its key and compile wall time — a
+        # recompile storm becomes a ranked account. None = one
+        # attribute load + None check per miss.
+        self.profile = None
         # the batcher thread owns steady-state lookups, but warm-path
         # callers (SubmissionEngine.warm_repair) pre-populate from the
         # submitter thread — the OrderedDict needs its own tiny lock
@@ -88,7 +95,13 @@ class ProgramCache:
                 return prog
         # build OUTSIDE the lock: builds compile device programs and
         # must not serialize against concurrent cache hits
-        prog = build()
+        prof = self.profile
+        if prof is None:
+            prog = build()
+        else:
+            t0 = time.perf_counter()
+            prog = build()
+            prof.compile_event(key, time.perf_counter() - t0)
         with self._mu:
             if key not in self._programs:
                 self._programs[key] = prog

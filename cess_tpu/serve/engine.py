@@ -212,7 +212,7 @@ class SubmissionEngine:
     def __init__(self, codec=None, audit=None,
                  policy: AdmissionPolicy | None = None,
                  resilience=None, tracer=None, slo=None, adaptive=None,
-                 admission=None, pool=None):
+                 admission=None, pool=None, profile=None):
         if codec is None and audit is None:
             raise ValueError("engine needs a codec and/or audit backend")
         self.codec = codec
@@ -233,6 +233,15 @@ class SubmissionEngine:
         self.admission = admission        # serve.adaptive.AdmissionController
         self.stats.slo = slo
         self.stats.adaptive = adaptive
+        # continuous profiling (obs/profile.py, ISSUE 13, opt-in): a
+        # ProfilePlane accounts every dispatch's stage breakdown and
+        # pad bill and (baseline-anchored) watches for throughput
+        # regressions. None = one attribute load + None check on the
+        # account path; the program cache times builds into it.
+        self.profile = profile
+        self.stats.profile = profile
+        if profile is not None:
+            self.programs.profile = profile
         # per-(class, tenant) served device rows: the weighted-fair
         # drain's deficit counters (engine-lock guarded, only ever
         # populated when a board is configured)
@@ -1103,7 +1112,7 @@ class SubmissionEngine:
             return False
         if mon is not None and not degraded:
             mon.record_success(time.monotonic() - t0)
-        self._account_batch(batch, device_rows, bspan)
+        self._account_batch(batch, device_rows, bspan, lane=lane, t0=t0)
         bspan.finish()
         for r, out in zip(batch, results):
             r.future._resolve(out)
@@ -1120,7 +1129,8 @@ class SubmissionEngine:
                         tenant=r.tenant, rows=r.rows)
 
     def _account_batch(self, batch: list[_Request], device_rows: int,
-                       batch_span=trace.NOOP_SPAN) -> None:
+                       batch_span=trace.NOOP_SPAN, lane=None,
+                       t0: float | None = None) -> None:
         done = time.monotonic()
         real_rows = sum(r.rows for r in batch)
         cls = batch[0].cls
@@ -1157,6 +1167,20 @@ class SubmissionEngine:
             occ = len(batch)
             for r in batch:
                 ad.note(cls, done - r.enqueue_t, occ)
+        prof = self.profile
+        if prof is not None:
+            # continuous profiling feed (obs/profile.py): the byte
+            # count and queue-wait sums are only computed when armed
+            prof.on_batch(
+                cls, device_rows,
+                0 if lane is None else lane.index,
+                rows=real_rows,
+                padded=max(device_rows - real_rows, 0),
+                requests=len(batch),
+                nbytes=sum(a.nbytes for r in batch
+                           for a in r.arrays.values()),
+                queue_s=sum(done - r.enqueue_t for r in batch),
+                dispatch_s=0.0 if t0 is None else done - t0)
         # span attribution only when the spans are real: the disabled
         # path must not pay the round()s / kwargs dicts per request
         if batch_span is not trace.NOOP_SPAN:
@@ -1234,7 +1258,7 @@ class SubmissionEngine:
                 r.span.set(outcome="error", error=repr(exc)).finish()
                 self._observe_failure(r, time.monotonic())
             else:
-                self._account_batch([r], rows)
+                self._account_batch([r], rows, lane=lane)
                 r.future._resolve(out[0])
                 r.span.set(outcome="ok").finish()
         return True
@@ -1457,7 +1481,8 @@ def make_engine(k: int | None = None, m: int | None = None, *,
                 podr2_key=None, audit_backend: str = "cpu",
                 policy: AdmissionPolicy | None = None,
                 resilience=None, tracer=None, slo=None, adaptive=None,
-                admission=None, pool=None) -> SubmissionEngine:
+                admission=None, pool=None,
+                profile=None) -> SubmissionEngine:
     """Build an engine over the two trait gates.
 
     k/m select the ErasureCodec geometry (None = no codec: the engine
@@ -1481,6 +1506,12 @@ def make_engine(k: int | None = None, m: int | None = None, *,
     DevicePool, or True (all local devices) / a device count N (the
     ``--pool[=N]`` CLI form). None/0/False = the single-device
     dispatch path, unchanged.
+    profile: optional cess_tpu.obs.profile.ProfilePlane — continuous
+    performance profiling: per-(class, bucket, device) stage
+    breakdowns, the unified pad ledger, program-cache compile events
+    and (when built with a bench baseline) the perf-regression
+    watchdog. None = the account path pays one attribute load + None
+    check per batch.
     """
     codec = None
     if k is not None:
@@ -1515,4 +1546,4 @@ def make_engine(k: int | None = None, m: int | None = None, *,
     return SubmissionEngine(codec, audit, policy, resilience=resilience,
                             tracer=tracer, slo=slo, adaptive=adaptive,
                             admission=admission or None,
-                            pool=pool or None)
+                            pool=pool or None, profile=profile)

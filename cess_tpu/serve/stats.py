@@ -145,6 +145,9 @@ class EngineStats:
         # the attachments above; exports the cess_engine_device_*
         # per-lane family
         self.pool = None
+        # ProfilePlane (obs/profile.py) when the engine is profiled —
+        # same duck-typed contract; exports the cess_profile_* family
+        self.profile = None
 
     def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
         """JSON-shaped dump for the RPC debug endpoint."""
@@ -177,6 +180,8 @@ class EngineStats:
             out["adaptive"] = self.adaptive.snapshot()
         if self.pool is not None:
             out["devices"] = self.pool.snapshot()
+        if self.profile is not None:
+            out["profile"] = self.profile.snapshot()
         return out
 
     def metrics(self, queue_depths: dict[str, int] | None = None
@@ -208,6 +213,9 @@ class EngineStats:
             # cess_engine_device_* per-lane placement/load/breaker
             # gauges (the multi-chip serving plane, serve/pool.py)
             out.update(self.pool.metrics())
+        if self.profile is not None:
+            # cess_profile_* continuous-profiling gauges (ISSUE 13)
+            out.update(self.profile.metrics())
         return out
 
     def histograms(self) -> dict[str, prom.Histogram]:

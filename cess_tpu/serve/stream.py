@@ -315,6 +315,14 @@ class StreamingIngest:
                 if eng is not None and eng.slo is not None:
                     eng.slo.observe("stream", h2d + dispatch,
                                     tenant=self.tenant, rows=real)
+                # continuous-profiling feed (obs/profile.py): the
+                # ragged tail's pad rides the SAME PadLedger as the
+                # engine's bucket padding — one end-to-end pad bill
+                if eng is not None and eng.profile is not None:
+                    eng.profile.on_stream(
+                        batch=self.batch, rows=real,
+                        nbytes=real * cfg.segment_size,
+                        h2d_s=h2d, dispatch_s=dispatch)
                 if bspan is not trace.NOOP_SPAN:
                     bspan.finish(h2d_s=round(h2d, 6),
                                  dispatch_s=round(dispatch, 6))

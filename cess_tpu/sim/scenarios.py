@@ -66,6 +66,13 @@ class Scenario:
       :data:`_FLEET_FEDERATE_EVERY`-th round its full /metrics
       exposition. The plane rides :attr:`SimReport.fleet` and its
       witness joins :meth:`SimReport.witness` as the fifth stream.
+    - ``profile``: arm a :class:`~cess_tpu.obs.profile.ProfilePlane`
+      on the ``pool`` engine (requires ``pool=True`` — the plane
+      accounts engine dispatches), so chaos campaigns leave the
+      per-shape stage breakdowns and the unified pad ledger behind;
+      the snapshot rides :attr:`SimReport.profile`. Unanchored (no
+      bench baseline inside a sim world), so the watchdog stays
+      inert — profiling without judging.
     """
 
     name: str
@@ -78,6 +85,7 @@ class Scenario:
     final_checks: tuple = ()
     pool: bool = False
     fleet: bool = False
+    profile: bool = False
 
 
 def resolve_ref(world: World, ref: str) -> int:
@@ -148,6 +156,12 @@ class SimReport:
     # snapshot + FleetBoard transition log + stitched trace set) IS
     # part of the replay contract, as the fifth witness stream
     fleet: "object | None" = None
+    # the continuous-profiling plane (ISSUE 13): the plane's
+    # end-of-run snapshot when the scenario ran ``profile=True`` —
+    # informational like ``pool`` (stage sums are wall-clock; the
+    # plane's OWN witness() determinism contract is exercised
+    # directly against the live engine in tests/test_profile.py)
+    profile: "dict | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -292,18 +306,26 @@ def _fleet_scrape(world: World, plane, rnd: int) -> None:
     plane.seal_round()
 
 
-def _pool_engine(world: World):
+def _pool_engine(world: World, profile: bool = False):
     """A device-pool submission engine matched to the world's storage
     pipeline: same RS geometry, same PoDR2 key (a mismatched key would
     tag with different secrets than the direct path), all visible
-    devices, breakers enabled so lane faults trip and drain."""
+    devices, breakers enabled so lane faults trip and drain. With
+    ``profile``, an unanchored ProfilePlane rides along (no bench
+    baseline inside a sim world — ledgers fill, watchdog inert)."""
     from ..resilience import ResilienceConfig
     from ..serve import make_engine
 
+    plane = None
+    if profile:
+        from ..obs.profile import ProfilePlane
+
+        plane = ProfilePlane()
     pipe = world.pipeline
     return make_engine(pipe.config.k, pipe.config.m, rs_backend="jax",
                        podr2_key=pipe.podr2_key,
-                       resilience=ResilienceConfig(), pool=True)
+                       resilience=ResilienceConfig(), pool=True,
+                       profile=plane)
 
 
 def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
@@ -321,8 +343,14 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     retention replays bit-identically) with the scenario's SLO targets
     as pin objectives."""
     seed_b = seed if isinstance(seed, bytes) else str(seed).encode()
+    if scenario.profile and not scenario.pool:
+        raise ValueError("Scenario.profile=True requires pool=True "
+                         "(the profile plane accounts engine "
+                         "dispatches)")
     world = _build_world(scenario, seed_b, n_nodes)
     pool_snap: dict = {}
+    profile_snap: dict = {}
+    profile_plane = None
     # tiny windows: scenario rounds produce a handful of observations
     # per class, and the transition log must be able to flip on them
     board = SloBoard(tuple(SloTarget(cls, p99_s=p99)
@@ -357,10 +385,14 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 # sim thread, so placement (and the fault plan's
                 # per-site ordinals) replay deterministically; the
                 # snapshot is captured before the engine closes.
-                eng = _pool_engine(world)
+                eng = _pool_engine(world, profile=scenario.profile)
+                profile_plane = eng.profile
                 stack.callback(eng.close)
                 stack.callback(lambda: pool_snap.update(
                     eng.pool.snapshot()))
+                if profile_plane is not None:
+                    stack.callback(lambda: profile_snap.update(
+                        profile_plane.snapshot()))
                 stack.callback(setattr, world.pipeline, "engine", None)
                 world.pipeline.engine = eng
             if scenario.fleet:
@@ -378,6 +410,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 recorder, board=board, plan=plan,
                 stitcher=None if fleet_plane is None
                 else fleet_plane.stitcher,
+                profile=profile_plane,
                 context=lambda: {
                     "scenario": scenario.name,
                     "seed": seed_b.hex(),
@@ -429,7 +462,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                      board=board, plan=plan, rounds_run=scenario.rounds,
                      uploads_active=active, recorder=recorder,
                      reporter=reporter, pool=pool_snap or None,
-                     fleet=fleet_plane)
+                     fleet=fleet_plane, profile=profile_snap or None)
 
 
 # -- the library --------------------------------------------------------------
@@ -501,9 +534,11 @@ SCENARIOS: dict[str, Scenario] = {
     # 10): gateway encodes/tags route through a device-pool engine
     # while a seeded fault kills every dispatch on lane 0 — the lane's
     # breakers trip, work drains to siblings, uploads still activate
-    # and storage still converges; the pool snapshot rides the report
+    # and storage still converges; the pool snapshot rides the report.
+    # profile=True (ISSUE 13): the same run leaves the per-shape
+    # stage breakdowns + unified pad ledger behind on SimReport
     "gateway_hotspot_pool": Scenario(
-        name="gateway_hotspot_pool", rounds=14, pool=True,
+        name="gateway_hotspot_pool", rounds=14, pool=True, profile=True,
         world=(("n_validators", 5),
                ("storage", (("n_miners", 4), ("n_gateways", 2)))),
         timeline=(

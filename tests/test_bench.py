@@ -30,6 +30,7 @@ EXPECTED = (
     "pool_stream_encode_tag_GiBps",
     "pool_podr2_tag_verify_frags_per_s",
     "fleet_federate_100nodes_ms",
+    "stream_encode_tag_profiled_GiBps",
 )
 
 
@@ -121,6 +122,16 @@ def test_bench_smoke_every_metric_finite():
     assert fl["n_nodes"] == 100
     assert fl["counters"] >= 100 and fl["gauges"] >= 100
     assert fl["histograms"] >= 1
+    # the profiling-cost pin (ISSUE 13): the same streamed run feeding
+    # an armed ProfilePlane through the attached engine — overhead
+    # fraction finite, and the armed run really profiled (every staged
+    # batch observed, the ragged tail's pad rows billed)
+    prof = got["stream_encode_tag_profiled_GiBps"]
+    assert math.isfinite(prof["profile_overhead_frac"])
+    assert math.isfinite(prof["unprofiled_GiBps"]) \
+        and prof["unprofiled_GiBps"] > 0
+    assert prof["observations"] >= 1
+    assert prof["pad_rows"] >= 1 and prof["served_rows"] >= 1
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:
@@ -257,6 +268,36 @@ class TestBenchDiff:
             {"metric": "x_GiBps", "value": 9.0}) + "\n")
         code, out, _ = _bench_diff(str(curr), "--against", str(prev))
         assert code == 0, out
+
+    def test_baseline_out_emits_the_watchdog_artifact(self, tmp_path):
+        # ISSUE 13 satellite: --baseline-out writes the per-metric
+        # baseline JSON the profile plane's PerfWatchdog consumes
+        # (node.cli --profile=PATH). Default source is the newest
+        # checked-in round, so the output must match the checked-in
+        # fixture exactly — regenerate tests/data/bench_baseline_r05
+        # when a newer BENCH round lands
+        out = tmp_path / "baseline.json"
+        code, _, err = _bench_diff("--baseline-out", str(out))
+        assert code == 0, err
+        art = json.loads(out.read_text())
+        with open(os.path.join(DATA, "bench_baseline_r05.json")) as f:
+            assert art == json.load(f)
+        assert art["round"] == "r05"
+        assert art["metrics"]["rs_4p8_encode_GiBps_per_chip"]["value"] \
+            > 0
+        # an explicit record is honored (per-metric n_devices rides
+        # along so the watchdog's human-facing provenance is complete)
+        code, _, _ = _bench_diff(self.CURR, "--baseline-out", str(out))
+        assert code == 0
+        assert json.loads(out.read_text())["source"] \
+            == "bench_diff_curr.json"
+        # incompatible with --history / multi-record invocations
+        code, _, err = _bench_diff("--history", "--baseline-out",
+                                   str(out))
+        assert code == 2 and "at most one" in err
+        code, _, err = _bench_diff(self.CURR, self.PREV,
+                                   "--baseline-out", str(out))
+        assert code == 2 and "at most one" in err
 
     def test_missing_previous_round_is_a_usage_error(self):
         code, _, err = _bench_diff(self.CURR, "--against",

@@ -670,6 +670,36 @@ class TestSimDeterminism:
             {"sim-wallclock", "sim-entropy"}
         assert lint(CLEAN_SIM, "cess_tpu/obs/fleet.py").findings == []
 
+    def test_profile_plane_joins_the_family(self):
+        """ISSUE 13: the continuous-profiling plane's accounts,
+        ledgers and watchdog transition log are count-sequenced into
+        the replay witness (every timing is measured by serve-layer
+        callers and passed in), so obs/profile.py joins the
+        determinism family — and the clean twin stays silent."""
+        assert rules_at(lint(DIRTY_SIM, "cess_tpu/obs/profile.py")) == \
+            {"sim-wallclock", "sim-entropy"}
+        assert lint(CLEAN_SIM, "cess_tpu/obs/profile.py").findings == []
+
+    def test_profile_module_scans_clean_under_every_family(self):
+        """ISSUE 13 satellite: the shipped obs/profile.py passes
+        trace-safety, lock-discipline, span-balance AND the sim
+        determinism family with zero suppressions; the dirty twins
+        prove each family really fires at that path, and the baseline
+        stays empty."""
+        for dirty, rule in ((DIRTY_TRACE, "trace-print"),
+                            (DIRTY_LOCK, "lock-unguarded-write"),
+                            (DIRTY_SPAN, "span-balance"),
+                            (DIRTY_SIM, "sim-wallclock")):
+            assert rule in rules_at(
+                lint(dirty, "cess_tpu/obs/profile.py")), rule
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "obs", "profile.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_fleet_module_scans_clean_under_every_family(self):
         """ISSUE 12 satellite: the shipped obs/fleet.py passes
         trace-safety, lock-discipline, span-balance AND the sim

@@ -36,6 +36,16 @@ informational: it always exits 0 unless a record fails to load.
     python tools/bench_diff.py --history               # all BENCH_r*.json
     python tools/bench_diff.py --history a.json b.json c.json --json
 
+``--baseline-out PATH`` extracts the newest round (or the one record
+given) as a per-metric baseline artifact — the EXACT file the
+PerfWatchdog (cess_tpu/obs/profile.py, ``node.cli
+--profile=PATH``) anchors its live regression guard to:
+``{"source": ..., "round": ..., "metrics": {m: {"value": v,
+"n_devices": n}}}``. Writes, prints the summary, exits 0.
+
+    python tools/bench_diff.py --baseline-out baseline.json
+    python tools/bench_diff.py BENCH_r05.json --baseline-out b.json
+
 Exit codes: 0 ok, 1 regression(s) past threshold, 2 usage/load error.
 """
 from __future__ import annotations
@@ -150,6 +160,24 @@ def diff(prev: dict[str, float], cur: dict[str, float],
             "regressions": [r["metric"] for r in regressions]}
 
 
+def baseline(path: str) -> dict:
+    """The per-metric baseline artifact for one record — what
+    ``--baseline-out`` writes and the profile plane's PerfWatchdog
+    consumes (obs/profile.py ``load_baseline``). Metrics sorted, each
+    with its value and (when the record carries it) device count."""
+    values, devs = load_record(path)
+    rnd = round_of(path)
+    metrics = {}
+    for name in sorted(values):
+        entry: dict = {"value": values[name]}
+        if name in devs:
+            entry["n_devices"] = devs[name]
+        metrics[name] = entry
+    return {"source": os.path.basename(path),
+            "round": f"r{rnd:02d}" if rnd >= 0 else None,
+            "metrics": metrics}
+
+
 def plateau_runs(values: list, tol_pct: float) -> list[tuple[int, int]]:
     """Maximal runs of consecutive rounds where the metric moved by at
     most ``tol_pct`` percent per step — [start, end] index pairs, only
@@ -252,11 +280,42 @@ def main(argv=None) -> int:
                     metavar="PCT",
                     help="per-round move (percent) under which a "
                          "metric counts as flat (default 2)")
+    ap.add_argument("--baseline-out", default=None, metavar="PATH",
+                    help="write the newest round (or the one record "
+                         "given) as a per-metric baseline JSON "
+                         "artifact — the file the profile plane's "
+                         "PerfWatchdog consumes (node.cli "
+                         "--profile=PATH) — and exit")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
 
     rounds = newest_rounds()
+    if args.baseline_out:
+        if args.history or len(args.records) > 1:
+            print("--baseline-out takes at most one record",
+                  file=sys.stderr)
+            return 2
+        source = args.records[0] if args.records else None
+        if source is None:
+            if not rounds:
+                print("no BENCH_r*.json records found and no record "
+                      "given", file=sys.stderr)
+                return 2
+            source = rounds[0]
+        try:
+            artifact = baseline(source)
+        except (OSError, ValueError) as e:
+            print(f"bench_diff: {e}", file=sys.stderr)
+            return 2
+        with open(args.baseline_out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"baseline ({artifact['round'] or 'unlabeled'}, "
+              f"{len(artifact['metrics'])} metric(s)) from "
+              f"{artifact['source']} -> {args.baseline_out}",
+              file=sys.stderr)
+        return 0
     if args.history:
         paths = args.records or sorted(rounds, key=round_of)
         if len(paths) < 2:

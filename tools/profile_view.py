@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Render a ``cess_profileDump`` snapshot as a human profile report.
+
+Input: a JSON file holding one ``cess_profileDump`` payload (what the
+RPC returns when a node runs with ``--profile``, or
+``ProfilePlane.snapshot()`` dumped from a sim run). Stdlib only;
+read-only.
+
+    python tools/profile_view.py profile.json
+    python tools/profile_view.py profile.json --accounts 30
+
+Layout mirrors how the plane is built: the watchdog verdict first
+(states vs the bench baseline, the transition log), then the
+per-(class, bucket, device) stage breakdown ranked by device busy
+time, then the pad ledger (worst pad bill first, per-source split),
+then the compile ledger (recompile storms rank to the top).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_STATE_MARK = {"ok": " ", "regressed": "*"}
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) or "ops" not in payload \
+            or "pads" not in payload:
+        raise SystemExit(f"{path}: not a cess_profileDump payload")
+    return payload
+
+
+def _render_watchdog(wd, out) -> None:
+    if wd is None:
+        print("watchdog: off (no bench baseline — profiling without "
+              "judging)", file=out)
+        return
+    states = wd.get("states", {})
+    print(f"watchdog: guard {wd.get('guard', 0):g} x baseline, "
+          f"window {wd.get('window', 0)} obs, "
+          f"{wd.get('observations', 0)} observation(s), "
+          f"{wd.get('regressions', 0)} regression(s):", file=out)
+    last = wd.get("last_GiBps", {})
+    baseline = wd.get("baseline", {})
+    for metric in sorted(states):
+        mark = _STATE_MARK.get(states[metric], "?")
+        v = last.get(metric)
+        live = "-" if v is None else f"{v:g} GiB/s"
+        print(f"  [{mark}] {metric:<44} {states[metric]:<10} "
+              f"live={live:<16} baseline={baseline.get(metric, 0):g}",
+              file=out)
+    transitions = wd.get("transitions", [])
+    print(f"  transition log ({len(transitions)} entries):", file=out)
+    for seq, metric, old, new, widx in transitions:
+        print(f"    obs {seq:>5}  window {widx:>3}  {metric:<40} "
+              f"{old} -> {new}", file=out)
+
+
+def _render_ops(ops: dict, limit: int, out) -> None:
+    accounts = ops.get("accounts", [])
+    print(f"stage breakdown: {ops.get('observations', 0)} "
+          f"observation(s), {len(accounts)} account(s) "
+          f"(window {ops.get('window', 0)}):", file=out)
+    gibps = ops.get("windowed_GiBps", {})
+    for cls in sorted(gibps):
+        v = gibps[cls]
+        print(f"  windowed {cls:<12} "
+              + ("-" if v is None else f"{v:g} GiB/s"), file=out)
+    busy = lambda a: a["h2d_s"] + a["dispatch_s"] + a["sync_s"]  # noqa: E731
+    ranked = sorted(accounts, key=busy, reverse=True)
+    shown = ranked[:limit]
+    if len(shown) < len(ranked):
+        print(f"  (top {len(shown)} of {len(ranked)} by busy time)",
+              file=out)
+    for a in shown:
+        print(f"  {a['cls']:<12} bucket={a['bucket']:<6} "
+              f"d{a['device']}  batches={a['batches']:<6} "
+              f"rows={a['rows']:<8} pad={a['padded_rows']:<8} "
+              f"queue={a['queue_s']:g}s h2d={a['h2d_s']:g}s "
+              f"dispatch={a['dispatch_s']:g}s sync={a['sync_s']:g}s",
+              file=out)
+
+
+def _render_pads(pads: dict, out) -> None:
+    total = pads.get("total", {})
+    served = total.get("served", 0)
+    padded = total.get("padded", 0)
+    frac = padded / (served + padded) if served + padded else 0.0
+    src = ", ".join(f"{k}={v}"
+                    for k, v in sorted(total.get("sources",
+                                                 {}).items()))
+    print(f"pad ledger: {padded} padded row(s) vs {served} served "
+          f"({100 * frac:.2f}% waste; {src or 'no sources'}):",
+          file=out)
+    for entry in pads.get("ranked", []):
+        srcs = ", ".join(f"{k}={v}"
+                         for k, v in sorted(entry.get("sources",
+                                                      {}).items()))
+        print(f"  {entry['cls']:<12} bucket={entry['bucket']:<6} "
+              f"padded={entry['padded']:<8} served={entry['served']:<8}"
+              f" batches={entry['batches']:<6} [{srcs}]", file=out)
+
+
+def _render_compiles(compiles: dict, out) -> None:
+    programs = compiles.get("programs", {})
+    print(f"compile ledger: {compiles.get('builds', 0)} build(s) over "
+          f"{len(programs)} program key(s):", file=out)
+    ranked = sorted(programs.items(),
+                    key=lambda kv: (-kv[1]["builds"], kv[0]))
+    for key, acct in ranked:
+        storm = "  RECOMPILE" if acct["builds"] > 1 else ""
+        print(f"  x{acct['builds']:<4} {acct['wall_s']:>9g}s  "
+              f"{key}{storm}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a cess_profileDump snapshot as a "
+                    "human-readable profile report")
+    ap.add_argument("path", help="cess_profileDump JSON payload")
+    ap.add_argument("--accounts", type=int, default=20, metavar="N",
+                    help="stage-breakdown accounts shown, ranked by "
+                         "device busy time (default 20)")
+    args = ap.parse_args(argv)
+    snap = _load(args.path)
+    out = sys.stdout
+    tracked = snap.get("tracked", {})
+    watched = ", ".join(f"{c}->{m}" for c, m in sorted(tracked.items()))
+    print(f"profile plane: tracking {watched or 'nothing'}", file=out)
+    print(file=out)
+    _render_watchdog(snap.get("watchdog"), out)
+    print(file=out)
+    _render_ops(snap.get("ops", {}), args.accounts, out)
+    print(file=out)
+    _render_pads(snap.get("pads", {}), out)
+    print(file=out)
+    _render_compiles(snap.get("compiles", {}), out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
