@@ -781,6 +781,73 @@ def bench_fleet(n_nodes: int, rounds: int = 5):
     }
 
 
+def bench_chainwatch(n_nodes: int, rounds: int = 5):
+    """chainwatch_100node_scan_ms: wall ms for ONE chain-plane scan
+    round at ``n_nodes`` — digest every node's consensus state (tail
+    diffing for reorgs, (author, slot) doubles for equivocation),
+    recompute the market ledger and run the four anomaly detectors
+    over the sealed views (cess_tpu/obs/chainwatch). The state dicts
+    are synthesized deterministically (no node stack in the loop), so
+    the number is the marginal cost of the plane itself — what
+    decides how often the net author loop can afford a scan. One warm
+    round runs outside the timed window."""
+    from cess_tpu.obs.chainwatch import TAIL, ChainWatch
+
+    def state(i: int, rnd: int) -> dict:
+        # deterministic per-(node, round) content shaped like a real
+        # chainwatch.node_state: a moving head, a hash tail, a few
+        # claimed blocks and one lock; node 7 lags and double-signs
+        h = (i * 2654435761 + rnd * 40503) & 0xFFFF
+        head = rnd * 3 + (h % 2)
+        finalized = max(0, head - (6 if i == 7 else h % 3))
+        tail = {str(n): f"{i % 5}-{n}"
+                for n in range(max(0, head - TAIL), head + 1)}
+        blocks = [[f"v{i % 4}", head, f"b{i % 5}-{head}"]]
+        if i == 7:
+            blocks.append([f"v{i % 4}", head, f"b-twin-{head}"])
+        return {"head": head, "finalized": finalized,
+                "slot": head + 1, "era": head // 10, "forks": h % 3,
+                "tail": tail, "blocks": blocks,
+                "locks": [["acct", max(0, head - 2)]],
+                "vote_equivocations": []}
+
+    def market(rnd: int) -> dict:
+        return {
+            "miners": {f"m{j}": {"idle": 1 << 28, "service": j << 23,
+                                 "lock": 0, "state": "positive",
+                                 "audited": j << 23}
+                       for j in range(8)},
+            "verdicts": {f"m{j}": [int((j + k + rnd) % 4 != 0)
+                                   for k in range(8)]
+                         for j in range(8)},
+            "restoral": {"open": rnd % 2, "claimed": 0,
+                         "generated": rnd, "claims": rnd,
+                         "completed": rnd},
+        }
+
+    def one_round(watch, rnd):
+        for i in range(n_nodes):
+            watch.ingest_state(f"n{i:03d}", state(i, rnd))
+        watch.ingest_market(market(rnd))
+        watch.seal_round()
+
+    watch = ChainWatch("bench")
+    one_round(watch, 0)                    # warm
+    t0 = time.perf_counter()
+    for rnd in range(1, rounds + 1):
+        one_round(watch, rnd)
+    wall_ms = (time.perf_counter() - t0) * 1e3 / rounds
+    snap = watch.snapshot()
+    return wall_ms, {
+        "n_nodes": n_nodes,
+        "rounds": rounds,
+        "reorgs": snap["consensus"]["reorgs"],
+        "equivocations": len(snap["consensus"]["equivocations"]),
+        "anomalies": snap["anomalies"]["anomalies"],
+        "miners": len(snap["market"]["miners"]),
+    }
+
+
 def main() -> None:
     global _ASSERT_FINITE
 
@@ -798,11 +865,11 @@ def main() -> None:
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
                          "pool,stream,degraded,traceov,adaptive,"
-                         "encode,sim,fleet,profile")
+                         "encode,sim,fleet,profile,chainwatch")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "pool", "stream",
              "degraded", "traceov", "adaptive", "encode", "sim",
-             "fleet", "profile"}
+             "fleet", "profile", "chainwatch"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -1139,6 +1206,23 @@ def main() -> None:
                     "clamp + histogram merge + global SLO board + "
                     "straggler scan, cess_tpu/obs/fleet); expositions "
                     "built outside the timed window; lower is better")
+
+    if "chainwatch" in which:
+        # host-only python like the fleet metric: the same 100-node
+        # shape runs under --smoke so the gate exercises the exact
+        # scan path the chain plane uses live (ISSUE 14)
+        wall_ms, extra = bench_chainwatch(100)
+        # vs_baseline: against one 6 s block interval — how many
+        # times per block the author loop could afford a 100-node
+        # chain-plane scan
+        emit("chainwatch_100node_scan_ms", wall_ms, "ms",
+             BLOCK_MS / wall_ms, **extra,
+             method="wall ms to close one chain-plane scan round over "
+                    "100 synthesized consensus states plus the market "
+                    "ledger (tail-diff reorg inference, equivocation "
+                    "doubles, spike/stall/deep-reorg detectors, "
+                    "cess_tpu/obs/chainwatch); states built outside "
+                    "the timed window; lower is better")
 
     if "encode" in which:
         emit("rs_4p8_encode_GiBps_per_chip", encode_gibps, "GiB/s",

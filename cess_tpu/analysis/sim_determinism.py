@@ -16,12 +16,13 @@ would flake instead of fail. These rules make the contract static:
 
 The family also covers the flight recorder's retention-decision code
 (obs/flight.py + obs/incident.py, ISSUE 9), the fleet plane
-(obs/fleet.py, ISSUE 12) and the profile plane (obs/profile.py,
-ISSUE 13): "same seed retains the same traces, bundles the same
-incidents, federates the same fleet witness and profiles the same
-counters" is the identical replay contract, so a wall-clock read or
-entropy draw in a pin decision, a scrape round or a watchdog window
-is the same class of bug as one in a sim world. (The profile plane's
+(obs/fleet.py, ISSUE 12), the profile plane (obs/profile.py,
+ISSUE 13) and the chain plane (obs/chainwatch.py, ISSUE 14): "same
+seed retains the same traces, bundles the same incidents, federates
+the same fleet witness, profiles the same counters and logs the same
+chain anomalies" is the identical replay contract, so a wall-clock
+read or entropy draw in a pin decision, a scrape round or a watchdog
+window is the same class of bug as one in a sim world. (The profile plane's
 timings are measured by its serve-layer CALLERS and passed in — the
 module itself never touches a clock.)
 """
@@ -46,13 +47,14 @@ class _SimRule(Rule):
         parts = path_parts(path)
         if "sim" in parts:
             return True
-        # the retention layer, the fleet plane and the profile plane
-        # make seeded decisions under the same replay contract as sim
-        # worlds
+        # the retention layer, the fleet plane, the profile plane and
+        # the chain plane make seeded decisions under the same replay
+        # contract as sim worlds
         return "obs" in parts and parts[-1] in ("flight.py",
                                                 "incident.py",
                                                 "fleet.py",
-                                                "profile.py")
+                                                "profile.py",
+                                                "chainwatch.py")
 
 
 @register

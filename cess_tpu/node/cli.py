@@ -146,6 +146,26 @@ def main(argv=None) -> int:
                          "incident bundles gain the stitched "
                          "cross-node trace view. Absent = zero-cost "
                          "off (the --trace contract)")
+    ap.add_argument("--chainwatch", action="store_true",
+                    help="arm the chain-plane observability watch "
+                         "(cess_tpu/obs/chainwatch.py) on this node: "
+                         "per-node consensus health (finality lag, "
+                         "reorg depth, fork counts, vote-lock ages, "
+                         "a block/vote equivocation detector with "
+                         "offences-shaped evidence records), the "
+                         "storage-market ledger (audit pass/fail "
+                         "spikes, declared-vs-audited capacity "
+                         "drift, restoral-auction accounting) and "
+                         "edge-triggered chain anomalies (finality-"
+                         "stall / deep-reorg / equivocation / audit-"
+                         "failure-spike incident triggers) — served "
+                         "via the cess_chainStatus RPC and as "
+                         "cess_chain_* gauges on GET /metrics "
+                         "(render with tools/chain_view.py). With "
+                         "--fleet, chain health rides the fleet "
+                         "gossip and peers fold per-node finality "
+                         "lag into their quorum views. Absent = "
+                         "zero-cost off (the --trace contract)")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="TARGETS",
                     help="attach an SLO board (cess_tpu/obs/slo.py) to "
@@ -363,6 +383,7 @@ def main(argv=None) -> int:
         nodes[0].flight = recorder
         nodes[0].incidents = reporter  # cess_incidentDump RPC surface
     plane = _arm_cli_fleet(args, nodes[0], reporter)
+    watch = _arm_cli_chainwatch(args, nodes[0], reporter, plane)
     rpc = None
     import threading
 
@@ -387,7 +408,13 @@ def main(argv=None) -> int:
                       f"finalized=#{nodes[0].finalized}", file=sys.stderr)
             slot += 1
             # single-process deployment: no gossip to scrape peers
-            # over, so the plane ticks itself (self-only federation)
+            # over, so the watch/plane tick themselves (self-only
+            # rounds; the watch scans first so its lag fold lands in
+            # the plane's same-slot seal)
+            if watch is not None and slot % 4 == 0:
+                with chain_lock:
+                    watch.scan_node(nodes[0])
+                watch.seal_round()
             if plane is not None and slot % 4 == 0:
                 with chain_lock:
                     plane.tick()
@@ -401,6 +428,7 @@ def main(argv=None) -> int:
         if engine is not None:
             engine.close()
         _finish_cli_profile(engine)
+        _finish_cli_chainwatch(watch)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)
@@ -507,8 +535,21 @@ def _arm_cli_fleet(args, node, reporter):
 
     def _source():
         board = getattr(getattr(node, "engine", None), "slo", None)
-        return (render_metrics(node),
-                None if board is None else board.snapshot())
+        slo = None if board is None else board.snapshot()
+        # with --chainwatch, chain health rides the fleet frame: the
+        # node's consensus state under "chain" plus a finality_lag
+        # SLO class every receiver's FleetBoard folds into its
+        # worst/quorum views. Late-bound getattr: the watch arms
+        # after the plane.
+        watch = getattr(node, "chainwatch", None)
+        if watch is not None:
+            chain_slo = watch.self_slo(node)
+            slo = dict(slo or {})
+            targets = dict(slo.get("targets") or {})
+            targets.update(chain_slo["targets"])
+            slo["targets"] = targets
+            slo["chain"] = chain_slo["chain"]
+        return (render_metrics(node), slo)
 
     plane.attach_source(_source)
     if reporter is not None:
@@ -530,6 +571,50 @@ def _finish_cli_fleet(plane, tracer) -> None:
           f"{len(snap['federation']['instances'])} instance(s), "
           f"{snap['stitch']['spans']} stitched span(s)",
           file=sys.stderr)
+
+
+def _arm_cli_chainwatch(args, node, reporter, plane):
+    """--chainwatch: arm a ChainWatch (obs/chainwatch.py) as
+    ``node.chainwatch``. The net author loop (TCP mode) or the main
+    loop (in-process mode) scans this node's own chain + market state
+    every few slots and seals a detector round; with --fleet the
+    node's consensus state rides the fleet gossip frames (the plane's
+    scrape source folds it into the slo dict) and per-node finality
+    lag feeds the plane's straggler windows at every seal. With
+    --flight, incident bundles embed the chain-health snapshot.
+    Returns the watch or None."""
+    if not getattr(args, "chainwatch", False):
+        return None
+    from ..obs.chainwatch import ChainWatch
+
+    watch = ChainWatch(node.name)
+    if plane is not None:
+        watch.attach_fleet(plane)
+    if reporter is not None:
+        reporter.chainwatch = watch
+    node.chainwatch = watch
+    return watch
+
+
+def _finish_cli_chainwatch(watch) -> None:
+    """Print the chain-watch summary: rounds, anomaly totals and the
+    currently-bad anomaly keys (render the full cess_chainStatus
+    payload with tools/chain_view.py)."""
+    if watch is None:
+        return
+    snap = watch.snapshot()
+    active = {cls: keys
+              for cls, keys in snap["anomalies"]["active"].items()
+              if keys}
+    verdict = "; ".join(f"{cls}: {','.join(keys)}"
+                        for cls, keys in sorted(active.items())) \
+        or "no active anomalies"
+    print(f"chain watch: {snap['rounds']} round(s), "
+          f"{len(snap['consensus']['nodes'])} node(s) watched, "
+          f"{len(snap['consensus']['equivocations'])} equivocation "
+          f"evidence record(s), "
+          f"{snap['anomalies']['anomalies']} anomaly edge(s); "
+          f"{verdict}", file=sys.stderr)
 
 
 def _finish_cli_profile(engine) -> None:
@@ -738,6 +823,7 @@ def _run_tcp_node(args, spec) -> int:
         node.flight = recorder
         node.incidents = reporter     # cess_incidentDump RPC surface
     plane = _arm_cli_fleet(args, node, reporter)
+    watch = _arm_cli_chainwatch(args, node, reporter, plane)
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
     rpc = None
@@ -770,6 +856,7 @@ def _run_tcp_node(args, spec) -> int:
         if engine is not None:
             engine.close()
         _finish_cli_profile(engine)
+        _finish_cli_chainwatch(watch)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
         _finish_cli_tracer(args, tracer)

@@ -70,6 +70,12 @@ def collect(node) -> dict[str, float]:
     tracer = _node_tracer(node)
     if tracer is not None:
         m["cess_trace_spans_dropped_total"] = float(tracer.dropped)
+    # chain-plane observability gauges (obs/chainwatch.py): finality
+    # lag / reorg / equivocation / market-ledger health when a
+    # ChainWatch plane is armed (node.cli --chainwatch)
+    chainwatch = getattr(node, "chainwatch", None)
+    if chainwatch is not None:
+        m.update(chainwatch.metrics())
     return m
 
 
@@ -96,15 +102,22 @@ def render_metrics(node) -> str:
     escaped label values and exactly ONE TYPE line per family, however
     many label sets it carries. tests/test_metrics.py round-trips this
     output."""
+    from ..obs import prom
+
     lines = []
     for name, value in sorted(collect(node).items()):
         kind = "counter" if name.endswith("_total") else "gauge"
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {value}")
+    # build-info gauge (standard Prometheus practice): constant 1 with
+    # the identifying facts as labels — joinable against every other
+    # family, and MetricFederator relabels it like any other series
+    info_labels = {"instance": node.name,
+                   "version": str(_spec_version(node))}
+    lines.append("# TYPE cess_build_info gauge")
+    lines.append(f"cess_build_info{prom.format_labels(info_labels)} 1")
     engine = getattr(node, "engine", None)
     if engine is not None:
-        from ..obs import prom
-
         for family, hist in sorted(engine.stats_histograms().items()):
             lines.extend(prom.render_histogram(family, hist))
         # labeled gauge/counter families (SLO board): group by family

@@ -62,7 +62,13 @@ Wire frame: [4-byte LE length][codec bytes]; payload tuples:
                                    receivers with a plane buffer the
                                    peer's scrape for their next round,
                                    everyone else drops it. Never
-                                   re-gossiped.
+                                   re-gossiped. With a chain watch
+                                   armed (node.cli --chainwatch) the
+                                   frame's slo dict also carries the
+                                   sender's consensus state under a
+                                   "chain" key (obs/chainwatch.py) —
+                                   chain health rides the SAME gossip,
+                                   no extra frame kind.
 
 Authority discovery is STRUCTURED (cess_tpu/node/dht.py): a Kademlia
 DHT on a second OS-assigned port answers single-shot find_node /
@@ -589,6 +595,13 @@ class NodeService:
             plane = getattr(self.node, "fleet", None)
             if plane is not None:
                 plane.ingest_frame(payload)
+            # the frame's slo dict may carry the sender's consensus
+            # state under a "chain" key: hand the SAME frame to an
+            # armed chain watch (obs/chainwatch.py) so peer finality
+            # lag feeds the anomaly detectors too
+            watch = getattr(self.node, "chainwatch", None)
+            if watch is not None:
+                watch.ingest_frame(payload)
         elif kind == "status":
             peer_head, _, peer_fin = payload
             now = time.time()
@@ -763,6 +776,22 @@ class NodeService:
             # peers and seals a local round over whatever peers
             # gossiped in since the last one. Disarmed cost: one
             # attribute load + None check per slot.
+            # chain-plane observability (obs/chainwatch.py): every
+            # FLEET_EVERY slots an armed watch scans this node's own
+            # chain + market state and seals a detector round (also
+            # folding per-node finality lag into an attached fleet
+            # plane's straggler windows). Disarmed cost: one
+            # attribute load + None check per slot.
+            watch = getattr(self.node, "chainwatch", None)
+            if watch is not None and slot % FLEET_EVERY == 0:
+                try:
+                    with self.lock:
+                        watch.scan_node(self.node)
+                    watch.seal_round()
+                except Exception as e:   # noqa: BLE001 — best-effort
+                    # observability must never kill authoring
+                    self._record_error(
+                        f"chainwatch round slot {slot}: {e!r}")
             plane = getattr(self.node, "fleet", None)
             if plane is not None and slot % FLEET_EVERY == 0:
                 try:

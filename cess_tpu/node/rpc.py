@@ -41,6 +41,11 @@ Methods:
                       breakdowns, pad/compile ledgers, watchdog
                       states + transitions; obs/profile.py, armed via
                       node.cli --profile)
+  cess_chainStatus   (chain-plane observability: per-node consensus
+                      health, equivocation evidence, the storage-
+                      market ledger and anomaly transitions;
+                      obs/chainwatch.py, armed via node.cli
+                      --chainwatch)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -363,6 +368,14 @@ class RpcServer:
             # state. Null when the node runs without a profile plane
             # (node.cli --profile).
             plane = getattr(node, "profile", None)
+            return None if plane is None else plane.snapshot()
+        if method == "cess_chainStatus":
+            # chain-plane observability (obs/chainwatch.py): per-node
+            # consensus views, equivocation evidence records, the
+            # storage-market ledger and the anomaly transition log.
+            # Null when the node runs without a chain watch
+            # (node.cli --chainwatch).
+            plane = getattr(node, "chainwatch", None)
             return None if plane is None else plane.snapshot()
         if method == "cess_sloStatus":
             # SLO observability debug surface (obs/slo.py): per-class

@@ -24,6 +24,11 @@ trigger class       journal entry (subsystem, kind)
                     "regressed"`` — the profile plane's bench-anchored
                     watchdog (obs/profile.py); the bundle embeds the
                     pad and compile ledgers
+``finality-stall``  ``("chain", "anomaly")`` with ``to == "bad"`` —
+``deep-reorg``      the chain plane's anomaly detector
+``equivocation``    (obs/chainwatch.py); the journal detail's ``cls``
+``audit-failure-``  names the trigger class and the bundle embeds the
+``spike``           chain-health snapshot
 ==================  ========================================================
 
 Each bundle is self-contained: the pinned traces, the journal tail,
@@ -62,7 +67,12 @@ from .trace import _json_safe
 # journal reacts to host-timed p99 estimates, so it is evidence, not
 # witness)
 _CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
-                        "finality", "flight", "fleet", "perf"))
+                        "finality", "flight", "fleet", "perf", "chain"))
+
+# the chain anomaly classes obs/chainwatch.py announces; the journal
+# detail's ``cls`` IS the trigger class (one note kind, four triggers)
+_CHAIN_TRIGGERS = frozenset(("finality-stall", "deep-reorg",
+                             "equivocation", "audit-failure-spike"))
 
 
 def _sanitize(value):
@@ -105,6 +115,10 @@ class IncidentReporter:
                    a ``profile`` snapshot section (both ledgers);
                    falls back to ``engine.profile`` when the engine
                    carries one.
+    chainwatch:    optional obs/chainwatch.py ChainWatch — bundles
+                   gain a ``chain`` snapshot section (consensus views,
+                   equivocation evidence, the market ledger), the
+                   chain-anomaly postmortem's health truth source.
     context:       optional callable returning a dict merged into each
                    bundle — sim runs supply the scenario seed +
                    witness needed to replay the episode.
@@ -113,7 +127,8 @@ class IncidentReporter:
     """
 
     def __init__(self, recorder, *, engine=None, board=None, plan=None,
-                 stitcher=None, profile=None, context=None,
+                 stitcher=None, profile=None, chainwatch=None,
+                 context=None,
                  max_per_class: int = 4,
                  max_bundles: int = 32, shed_storm: int = 8,
                  journal_tail: int = 64):
@@ -127,6 +142,7 @@ class IncidentReporter:
         self.stitcher = stitcher
         self.profile = profile if profile is not None \
             else getattr(engine, "profile", None)
+        self.chainwatch = chainwatch
         self.context = context
         self.max_per_class = max_per_class
         self.shed_storm = shed_storm
@@ -187,6 +203,16 @@ class IncidentReporter:
                 return
             self.trigger("perf-regression",
                          key=str(detail.get("metric")), detail=detail)
+        elif subsystem == "chain" and kind == "anomaly":
+            # edge-triggered both ways by the detector; only the
+            # ok->bad edge is an incident, and the detail's cls must
+            # name a known trigger class (a skewed peer's journal
+            # entry must not mint arbitrary classes)
+            cls = detail.get("cls")
+            if detail.get("to") != "bad" or cls not in _CHAIN_TRIGGERS:
+                return
+            self.trigger(cls, key=str(detail.get("key")),
+                         detail=detail)
 
     # -- triggering ----------------------------------------------------------
     def trigger(self, cls: str, key: str, detail: dict) -> dict | None:
@@ -248,6 +274,12 @@ class IncidentReporter:
             # Evidence-side only: compile wall times are host timings
             # and must never reach canon
             snapshots["profile"] = profile.ledgers()
+        chainwatch = self.chainwatch
+        if chainwatch is not None:
+            # the chain-health truth source rides every bundle — the
+            # chain-anomaly postmortem's consensus views, equivocation
+            # evidence and market ledger at trigger time
+            snapshots["chain"] = chainwatch.snapshot()
         stitcher = self.stitcher
         stitched = [] if stitcher is None else stitcher.traces()
         with self._mu:

@@ -16,8 +16,9 @@ for join actions) — so one scenario runs unchanged at 40, 100 or
 
 The witness (:meth:`SimReport.witness`) bundles the event queue's
 fired log, every alive node's finalized prefix, the SLO board's
-transition log and the fault plan's fired log: four independent
-deterministic streams that must ALL match across same-seed replays.
+transition log, the fault plan's fired log, and — when armed — the
+fleet plane's and chain watch's witnesses: independent deterministic
+streams that must ALL match across same-seed replays.
 """
 from __future__ import annotations
 
@@ -73,6 +74,17 @@ class Scenario:
       the snapshot rides :attr:`SimReport.profile`. Unanchored (no
       bench baseline inside a sim world), so the watchdog stays
       inert — profiling without judging.
+    - ``chainwatch``: arm a
+      :class:`~cess_tpu.obs.chainwatch.ChainWatch` as
+      ``world.chainwatch`` and run one chain scan per virtual round:
+      every alive node's consensus state (head/finalized/forks/vote
+      locks/claimed blocks) plus the market ledger from the lowest
+      alive node's runtime. The anomaly detector's triggers land in
+      the armed incident reporter (the bundle embeds the chain
+      snapshot), per-node finality lag folds into an armed ``fleet``
+      plane (SLO class via :func:`_fleet_scrape`, straggler samples
+      at seal), and the watch's witness joins
+      :meth:`SimReport.witness` as the sixth stream.
     """
 
     name: str
@@ -86,6 +98,7 @@ class Scenario:
     pool: bool = False
     fleet: bool = False
     profile: bool = False
+    chainwatch: bool = False
 
 
 def resolve_ref(world: World, ref: str) -> int:
@@ -162,6 +175,11 @@ class SimReport:
     # plane's OWN witness() determinism contract is exercised
     # directly against the live engine in tests/test_profile.py)
     profile: "dict | None" = None
+    # the chain-plane watch (ISSUE 14): the run's ChainWatch when the
+    # scenario ran ``chainwatch=True`` — its witness (consensus views
+    # + equivocation evidence + market ledger + anomaly transition
+    # log) IS part of the replay contract, as the sixth witness stream
+    chainwatch: "object | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -170,7 +188,9 @@ class SimReport:
                 self.world.finalized_prefix(),
                 self.board.transition_log(),
                 self.plan.fired_log() if self.plan is not None else (),
-                self.fleet.witness() if self.fleet is not None else b"")
+                self.fleet.witness() if self.fleet is not None else b"",
+                self.chainwatch.witness()
+                if self.chainwatch is not None else b"")
 
 
 def _build_world(scenario: Scenario, seed, n_nodes: int | None) -> World:
@@ -264,8 +284,50 @@ def _apply_action(world: World, pending: dict, rnd: int,
                                       world.gateways):
                     repaired += 1
         world.queue.mark(f"repair_contend:{repaired}")
+    elif action == "equivocate":
+        _equivocate(world, args[0])
     else:
         raise ValueError(f"unknown scenario action {action!r}")
+
+
+def _equivocate(world: World, ref: str) -> None:
+    """A seeded double-signer. The slot claim signs (slot, author) but
+    NOT the block contents, so re-issuing the same claim over
+    different contents is exactly the BABE equivocation shape: forge
+    a twin of the validator's newest unfinalized canonical block
+    (mutated state root, same claim) and deliver it to every alive
+    node. The twin's claim verifies, it lands as a side branch (equal
+    weight — never adopted), and every chain watch now sees two
+    distinct blocks signed by one author for one slot."""
+    from ..node.network import Block
+
+    want = f"v{resolve_ref(world, ref)}"
+    src = next(i for i in range(world.n) if world.alive[i])
+    node = world.nodes[src]
+    header = None
+    for h in reversed(node.chain):
+        if h.claim is None or h.number <= node.finalized:
+            continue
+        header = h
+        if h.author == want:
+            break
+    if header is None:
+        raise LookupError(f"equivocate: no unfinalized canonical "
+                          f"block to double-sign (finalized="
+                          f"#{node.finalized})")
+    twin = dataclasses.replace(
+        header, state_root=hashlib.sha256(
+            b"cess-sim-equivocation:" + header.state_root).digest())
+    blk = Block(header=twin, extrinsics=())
+    for i in range(world.n):
+        if not world.alive[i]:
+            continue
+        try:
+            world.nodes[i].import_block(blk)
+        except ValueError:
+            continue    # other partition / finalized past it: no view
+    world.queue.mark(
+        f"equivocate:{header.author}@{header.claim.slot}")
 
 
 # every node's SLO state + straggler sample feeds the fleet plane
@@ -292,18 +354,56 @@ def _fleet_scrape(world: World, plane, rnd: int) -> None:
         return
     best = max(heads.values())
     federate = rnd % _FLEET_FEDERATE_EVERY == 0
+    watch = world.chainwatch
     for i in sorted(heads):
         inst = f"n{i:03d}"
         lag = float(best - heads[i])
         state = "ok" if lag <= 1 else ("warn" if lag <= 4
                                        else "burning")
+        targets = {"head": {"state": state}}
+        if watch is not None:
+            # chain-plane fold (obs/chainwatch.py): the node's
+            # finality lag joins the same scrape as an SLO class, so
+            # the FleetBoard's worst/quorum views flip when a quorum
+            # of nodes stops finalizing — the sim-side analog of the
+            # "chain" section riding live fleet gossip frames.
+            # Graded against the BEST alive head (the head-lag
+            # convention above): a stalled quorum keeps authoring
+            # somewhere, so best - finalized grows for everyone
+            from ..obs import chainwatch as _chainwatch
+
+            flag = int(best) - world.nodes[i].finalized
+            targets["finality_lag"] = {
+                "state": _chainwatch.lag_state(flag), "lag": flag}
         plane.ingest(
             inst,
             exposition=render_metrics(world.nodes[i])
             if federate else None,
-            slo={"targets": {"head": {"state": state}}})
+            slo={"targets": targets})
         plane.stragglers.observe(inst, "head_lag", lag)
     plane.seal_round()
+
+
+def _chainwatch_scrape(world: World, watch, rnd: int) -> None:
+    """One chain-plane scan round over the world (obs/chainwatch.py):
+    every alive node contributes its consensus state (the same
+    :func:`~cess_tpu.obs.chainwatch.node_state` dict a live node's
+    gossip frame carries), the lowest alive node's runtime feeds the
+    market ledger (chain state is replicated — one copy suffices),
+    and the seal runs the anomaly detectors. Crashed nodes skip the
+    scan — their last reported view stands, like a silent peer."""
+    from ..obs import chainwatch as _chainwatch
+
+    alive = [i for i in range(world.n) if world.alive[i]]
+    if not alive:
+        return
+    for i in alive:
+        watch.ingest_state(f"n{i:03d}",
+                           _chainwatch.node_state(world.nodes[i]))
+    watch.ingest_market(_chainwatch.market_state(
+        world.nodes[alive[0]].runtime.state,
+        fragment_size=watch.fragment_size))
+    watch.seal_round()
 
 
 def _pool_engine(world: World, profile: bool = False):
@@ -362,6 +462,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     plan = None
     reporter = None
     fleet_plane = None
+    chain_watch = None
     stack = contextlib.ExitStack()
     try:
         with stack:
@@ -404,6 +505,19 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
 
                 fleet_plane = FleetPlane("sim")
                 world.fleet = fleet_plane
+            if scenario.chainwatch:
+                # the chain-plane watch (obs/chainwatch.py): armed as
+                # world.chainwatch; one scan + detector seal per
+                # virtual round, folding per-node finality lag into
+                # the fleet plane's straggler windows when one rides
+                from ..constants import FRAGMENT_SIZE
+                from ..obs.chainwatch import ChainWatch
+
+                chain_watch = ChainWatch("sim",
+                                         fragment_size=FRAGMENT_SIZE)
+                if fleet_plane is not None:
+                    chain_watch.attach_fleet(fleet_plane)
+                world.chainwatch = chain_watch
             # each bundle embeds the scenario identity + the live
             # witness streams — everything a replay needs
             reporter = IncidentReporter(
@@ -411,6 +525,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 stitcher=None if fleet_plane is None
                 else fleet_plane.stitcher,
                 profile=profile_plane,
+                chainwatch=chain_watch,
                 context=lambda: {
                     "scenario": scenario.name,
                     "seed": seed_b.hex(),
@@ -436,6 +551,11 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                     active += _drive_uploads(world, pending, board, rnd)
                     board.observe("round",
                                   latency_s=float(world.last_round_slots))
+                    if chain_watch is not None:
+                        # scan BEFORE the fleet scrape: the watch's
+                        # straggler fold must land in the same fleet
+                        # round the plane seals below
+                        _chainwatch_scrape(world, chain_watch, rnd)
                     if fleet_plane is not None:
                         _fleet_scrape(world, fleet_plane, rnd)
                     run_checks(world, scenario.checks,
@@ -462,7 +582,8 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                      board=board, plan=plan, rounds_run=scenario.rounds,
                      uploads_active=active, recorder=recorder,
                      reporter=reporter, pool=pool_snap or None,
-                     fleet=fleet_plane, profile=profile_snap or None)
+                     fleet=fleet_plane, profile=profile_snap or None,
+                     chainwatch=chain_watch)
 
 
 # -- the library --------------------------------------------------------------
@@ -574,6 +695,28 @@ SCENARIOS: dict[str, Scenario] = {
         slo=(("round", 4.0), ("upload", 2.0)),
         checks=("finalized-prefix", "vote-locks", "fleet-consistency"),
         final_checks=("storage-convergence",),
+    ),
+    # the byzantine chain-plane campaign (ISSUE 14): a 4-way stripe
+    # stalls finality (no group holds 4 of 5 validators), and mid-
+    # partition a seeded double-signer re-issues a slot claim over
+    # forged contents — the chain watch's equivocation detector
+    # records offences-shaped evidence and fires the `equivocation`
+    # incident, growing finality lag fires `finality-stall`, the
+    # fleet quorum finality_lag view flips to warn and recovers
+    # after the heal, and the watch's witness joins the replay
+    # contract as the sixth stream
+    "equivocating_validator": Scenario(
+        name="equivocating_validator", rounds=14, fleet=True,
+        chainwatch=True,
+        world=(("n_validators", 5),),
+        timeline=(
+            (3, "stripe", 4),
+            (6, "equivocate", "validator:1"),
+            (9, "heal",),
+        ),
+        checks=("finalized-prefix", "vote-locks",
+                "fleet-consistency"),
+        final_checks=("heads-converged",),
     ),
     # a miner loses a fragment; TWO non-assigned rescuers race the
     # restoral order — both reconstruct, the market pays exactly one

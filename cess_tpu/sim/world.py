@@ -167,6 +167,9 @@ class World:
         # fleet=True scenarios; None keeps every fleet hook a single
         # attribute load + None check (the zero-cost-off contract)
         self.fleet = None
+        # the chain-plane watch (obs/chainwatch.py): armed by
+        # chainwatch=True scenarios under the same zero-cost contract
+        self.chainwatch = None
         if storage is not None:
             storage.install(self)
 

@@ -31,6 +31,7 @@ EXPECTED = (
     "pool_podr2_tag_verify_frags_per_s",
     "fleet_federate_100nodes_ms",
     "stream_encode_tag_profiled_GiBps",
+    "chainwatch_100node_scan_ms",
 )
 
 
@@ -132,6 +133,14 @@ def test_bench_smoke_every_metric_finite():
         and prof["unprofiled_GiBps"] > 0
     assert prof["observations"] >= 1
     assert prof["pad_rows"] >= 1 and prof["served_rows"] >= 1
+    # the chain-plane scan metric (ISSUE 14): the SAME 100-node shape
+    # runs under --smoke — tail-diff + equivocation doubles + market
+    # ledger + detectors over 100 synthesized states, with the
+    # detector counts riding along so a silently-empty scan can't pass
+    cw = got["chainwatch_100node_scan_ms"]
+    assert cw["n_nodes"] == 100
+    assert cw["equivocations"] >= 1 and cw["anomalies"] >= 1
+    assert cw["miners"] >= 1
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:

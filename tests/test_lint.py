@@ -680,6 +680,37 @@ class TestSimDeterminism:
             {"sim-wallclock", "sim-entropy"}
         assert lint(CLEAN_SIM, "cess_tpu/obs/profile.py").findings == []
 
+    def test_chainwatch_plane_joins_the_family(self):
+        """ISSUE 14: the chain plane's scans, evidence log and anomaly
+        transitions are count-sequenced into the replay witness, so
+        obs/chainwatch.py joins the determinism family next to
+        fleet.py and profile.py — and the clean twin stays silent."""
+        assert rules_at(
+            lint(DIRTY_SIM, "cess_tpu/obs/chainwatch.py")) == \
+            {"sim-wallclock", "sim-entropy"}
+        assert lint(CLEAN_SIM,
+                    "cess_tpu/obs/chainwatch.py").findings == []
+
+    def test_chainwatch_module_scans_clean_under_every_family(self):
+        """ISSUE 14 satellite: the shipped obs/chainwatch.py passes
+        trace-safety, lock-discipline, span-balance AND the sim
+        determinism family with zero suppressions; the dirty twins
+        prove each family really fires at that path, and the baseline
+        stays empty."""
+        for dirty, rule in ((DIRTY_TRACE, "trace-print"),
+                            (DIRTY_LOCK, "lock-unguarded-write"),
+                            (DIRTY_SPAN, "span-balance"),
+                            (DIRTY_SIM, "sim-wallclock")):
+            assert rule in rules_at(
+                lint(dirty, "cess_tpu/obs/chainwatch.py")), rule
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "obs", "chainwatch.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_profile_module_scans_clean_under_every_family(self):
         """ISSUE 13 satellite: the shipped obs/profile.py passes
         trace-safety, lock-discipline, span-balance AND the sim

@@ -275,6 +275,30 @@ def _assert_scenario_behavior(name, report):
         fed = report.fleet.federator.snapshot()
         assert len(fed["instances"]) == report.world.n
         assert fed["round"] >= 1
+    elif name == "equivocating_validator":
+        # ISSUE 14: the forged twin block is detected as BABE-shaped
+        # equivocation evidence (two hashes, one author, one slot) and
+        # the stripe stall + heal reorg both fired their anomaly
+        # triggers through the incident plane
+        triggers = [b["trigger"] for b in report.reporter.bundles()]
+        assert "equivocation" in triggers
+        assert "finality-stall" in triggers
+        ev = report.chainwatch.consensus.evidence()
+        assert any(e["kind"] == "block-equivocation"
+                   and len(e["hashes"]) == 2 for e in ev)
+        # the equivocation bundle embeds the chain-plane snapshot
+        bundle = next(b for b in report.reporter.bundles()
+                      if b["trigger"] == "equivocation")
+        assert "chain" in bundle["snapshots"]
+        # the stall is visible at FLEET level: the global quorum
+        # finality-lag view flipped to warn and recovered on heal
+        fl = [(v, frm, to)
+              for c, v, frm, to, _r in report.fleet.board.transition_log()
+              if c == "finality_lag"]
+        assert ("quorum", "ok", "warn") in fl
+        assert ("quorum", "warn", "ok") in fl
+        assert fl.index(("quorum", "ok", "warn")) \
+            < fl.index(("quorum", "warn", "ok"))
 
 
 @pytest.mark.parametrize("name", sorted(SCENARIOS))

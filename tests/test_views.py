@@ -1,0 +1,90 @@
+"""Renderer smoke tests: every ``tools/*_view.py`` script drives its
+real snapshot fixture end-to-end (ISSUE 14 satellite).
+
+The fixtures under ``tests/data/`` are genuine payloads dumped from
+deterministic sim runs — ``chain_status.json`` /
+``fleet_status.json`` / ``incident_dump.json`` came out of one
+``equivocating_validator`` run (seed ``b"fixtures"``, 20 nodes) and
+``profile_dump.json`` out of ``gateway_hotspot_pool`` — so a renderer
+that drifts from its plane's snapshot shape fails here, not in an
+operator's terminal. Each viewer must exit 0, print its section
+anchors, and refuse a payload belonging to a different RPC.
+"""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "tests", "data")
+
+
+def _viewer(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _fixture(name):
+    return os.path.join(DATA, name)
+
+
+class TestViewerSmoke:
+    def test_chain_view_renders_the_chain_status_fixture(self, capsys):
+        mod = _viewer("chain_view")
+        assert mod.main([_fixture("chain_status.json")]) == 0
+        out = capsys.readouterr().out
+        assert "chain plane:" in out
+        assert "consensus:" in out
+        assert "equivocation evidence" in out
+        assert "block-equivocation" in out
+        assert "market:" in out
+        assert "anomalies:" in out
+        assert "transition log" in out
+
+    def test_chain_view_node_table_is_capped(self, capsys):
+        mod = _viewer("chain_view")
+        assert mod.main([_fixture("chain_status.json"),
+                         "--nodes", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "top 3 of" in out
+
+    def test_fleet_view_renders_the_fleet_status_fixture(self, capsys):
+        mod = _viewer("fleet_view")
+        assert mod.main([_fixture("fleet_status.json")]) == 0
+        out = capsys.readouterr().out
+        assert "fleet plane @" in out
+        # the chain-plane fold is visible at fleet level: the board
+        # carries the finality_lag SLO class next to head
+        assert "finality_lag" in out
+
+    def test_profile_view_renders_the_profile_dump_fixture(self,
+                                                           capsys):
+        mod = _viewer("profile_view")
+        assert mod.main([_fixture("profile_dump.json")]) == 0
+        out = capsys.readouterr().out
+        assert "profile plane:" in out
+        assert "pad ledger:" in out
+        assert "compile ledger:" in out
+
+    def test_incident_view_renders_the_incident_dump_fixture(self,
+                                                             capsys):
+        mod = _viewer("incident_view")
+        assert mod.main([_fixture("incident_dump.json")]) == 0
+        out = capsys.readouterr().out
+        assert "incident #" in out
+        assert "equivocation" in out
+        assert "finality-stall" in out
+
+    def test_viewers_reject_foreign_payloads(self):
+        # each _load names its RPC in the rejection so an operator
+        # who mixes up dump files learns which file they actually got
+        for viewer, wrong in (("chain_view", "fleet_status.json"),
+                              ("fleet_view", "chain_status.json"),
+                              ("profile_view", "chain_status.json"),
+                              ("incident_view", "profile_dump.json")):
+            mod = _viewer(viewer)
+            with pytest.raises(SystemExit):
+                mod.main([_fixture(wrong)])

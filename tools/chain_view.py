@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Render a ``cess_chainStatus`` snapshot as a human chain report.
+
+Input: a JSON file holding one ``cess_chainStatus`` payload (what the
+RPC returns when a node runs with ``--chainwatch``, or
+``ChainWatch.snapshot()`` dumped from a sim run). Stdlib only;
+read-only.
+
+    python tools/chain_view.py chain.json
+    python tools/chain_view.py chain.json --nodes 30
+
+Layout mirrors how the plane is built: the consensus ledger first
+(per-node finality table ranked by lag, then the equivocation
+evidence), then the storage-market ledger (space totals, restoral
+accounting, per-miner audit table with fake-capacity and spike
+flags), then the anomaly detector (active keys per class and the
+count-sequenced transition log — the replay witness).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) or "consensus" not in payload \
+            or "market" not in payload:
+        raise SystemExit(f"{path}: not a cess_chainStatus payload")
+    return payload
+
+
+def _render_consensus(con: dict, limit: int, out) -> None:
+    nodes = con.get("nodes", {})
+    print(f"consensus: {con.get('scans', 0)} scan(s) over "
+          f"{len(nodes)} node(s), {con.get('reorgs', 0)} reorg(s) "
+          f"(deepest {con.get('max_reorg_depth', 0)}), lock horizon "
+          f"{con.get('lock_horizon', 0)}:", file=out)
+    ranked = sorted(nodes.items(),
+                    key=lambda kv: (-kv[1].get("lag", 0), kv[0]))
+    shown = ranked[:limit]
+    if len(shown) < len(ranked):
+        print(f"  (top {len(shown)} of {len(ranked)} by finality lag)",
+              file=out)
+    for inst, v in shown:
+        mark = "*" if v.get("lag", 0) > 0 else " "
+        print(f"  [{mark}] {inst:<10} head={v.get('head', 0):<8} "
+              f"final={v.get('finalized', 0):<8} "
+              f"lag={v.get('lag', 0):<4} slot={v.get('slot', 0):<6} "
+              f"era={v.get('era', 0):<3} forks={v.get('forks', 0):<4} "
+              f"locks={v.get('locks', 0)} "
+              f"lock_age={v.get('max_lock_age', 0)} "
+              f"reorg={v.get('reorg_depth', 0)}", file=out)
+    evidence = con.get("equivocations", [])
+    print(f"  equivocation evidence ({len(evidence)} record(s)):",
+          file=out)
+    for ev in evidence:
+        hashes = ", ".join(h[:12] for h in ev.get("hashes", ()))
+        print(f"    {ev.get('kind', '?'):<20} "
+              f"{ev.get('offender', '?'):<8} "
+              f"round {ev.get('round', 0):<6} [{hashes}]", file=out)
+
+
+def _render_market(mkt: dict, out) -> None:
+    space = mkt.get("space", {})
+    miners = mkt.get("miners", {})
+    print(f"market: {mkt.get('scans', 0)} scan(s), {len(miners)} "
+          f"miner(s), idle={space.get('idle', 0)} "
+          f"service={space.get('service', 0)} "
+          f"audited={space.get('audited', 0)} "
+          f"drift={space.get('drift', 0)}:", file=out)
+    rst = mkt.get("restoral", {})
+    print(f"  restoral: {rst.get('open', 0)} open, "
+          f"{rst.get('claimed', 0)} claimed, "
+          f"{rst.get('generated', 0)} generated, "
+          f"{rst.get('claims', 0)} claim(s), "
+          f"{rst.get('completed', 0)} completed", file=out)
+    ranked = sorted(
+        miners.items(),
+        key=lambda kv: (-int(kv[1].get("spike", False)),
+                        -kv[1].get("fails", 0),
+                        -abs(kv[1].get("drift", 0)), kv[0]))
+    for miner, v in ranked:
+        flags = "".join((" SPIKE" if v.get("spike") else "",
+                         " FAKE-CAP" if v.get("fake_capacity") else ""))
+        print(f"  {miner:<8} {v.get('state', '?'):<10} "
+              f"idle={v.get('idle', 0):<12} "
+              f"service={v.get('service', 0):<12} "
+              f"audited={v.get('audited', 0):<12} "
+              f"drift={v.get('drift', 0):<10} "
+              f"pass={v.get('passes', 0):<4} "
+              f"fail={v.get('fails', 0):<4}{flags}", file=out)
+
+
+def _render_anomalies(anom: dict, out) -> None:
+    active = anom.get("active", {})
+    burning = sum(len(keys) for keys in active.values())
+    print(f"anomalies: {anom.get('anomalies', 0)} transition(s) seen, "
+          f"{burning} key(s) active now:", file=out)
+    for cls in sorted(active):
+        keys = active[cls]
+        print(f"  {cls:<22} "
+              + (", ".join(sorted(keys)) if keys else "-"), file=out)
+    transitions = anom.get("transitions", [])
+    print(f"  transition log ({len(transitions)} entries):", file=out)
+    for seq, cls, key, frm, to in transitions:
+        print(f"    seq {seq:>5}  {cls:<22} {key:<16} {frm} -> {to}",
+              file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a cess_chainStatus snapshot as a "
+                    "human-readable chain-plane report")
+    ap.add_argument("path", help="cess_chainStatus JSON payload")
+    ap.add_argument("--nodes", type=int, default=20, metavar="N",
+                    help="consensus-table nodes shown, ranked by "
+                         "finality lag (default 20)")
+    args = ap.parse_args(argv)
+    snap = _load(args.path)
+    out = sys.stdout
+    print(f"chain plane: instance {snap.get('instance', '?')}, "
+          f"{snap.get('rounds', 0)} sealed round(s)", file=out)
+    print(file=out)
+    _render_consensus(snap.get("consensus", {}), args.nodes, out)
+    print(file=out)
+    _render_market(snap.get("market", {}), out)
+    print(file=out)
+    _render_anomalies(snap.get("anomalies", {}), out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
