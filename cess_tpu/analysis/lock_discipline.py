@@ -283,7 +283,12 @@ class _NodeRule(Rule):
         # between submitter, batcher and scrape threads.
         # sim/ joined in ISSUE 8: the sim is single-threaded by design,
         # so any lock it grows must follow the same discipline as the
-        # threaded stack it stands in for
+        # threaded stack it stands in for.
+        # ops/regen.py joined in ISSUE 15: RegenCodec's warm/apply
+        # caches are shared by the engine batcher and pool-lane worker
+        # threads, so any locking it grows is this family's territory
+        if "ops" in parts and parts[-1] == "regen.py":
+            return True
         return "serve" in parts or "node" in parts \
             or "resilience" in parts or "obs" in parts \
             or "sim" in parts

@@ -47,6 +47,12 @@ class _SimRule(Rule):
         parts = path_parts(path)
         if "sim" in parts:
             return True
+        # the regenerating repair plane (ISSUE 15): its coefficient
+        # and matrix constructions feed the repair storm's replay
+        # contract, so a clock read or entropy draw there would break
+        # bit-identical replays just like one inside sim/
+        if "ops" in parts and parts[-1] == "regen.py":
+            return True
         # the retention layer, the fleet plane, the profile plane and
         # the chain plane make seeded decisions under the same replay
         # contract as sim worlds

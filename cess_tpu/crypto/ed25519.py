@@ -43,14 +43,49 @@ def _add(p, q):
     return (e * f % P, g * h % P, f * g % P, e * h % P)
 
 
-def _mul(s: int, p=BASE):
+def _mul(s: int, p=None):
     q = (0, 1, 1, 0)
+    if p is None:
+        # base-point multiply: the doublings 2^i*B are shared by every
+        # scalar, so they are precomputed once (_BASE_POW2) and only
+        # the conditional adds remain
+        return _mul_tab(s, _BASE_POW2)
     while s:
         if s & 1:
             q = _add(q, p)
         p = _add(p, p)
         s >>= 1
     return q
+
+
+def _mul_tab(s: int, table):
+    q = (0, 1, 1, 0)
+    for i in range(s.bit_length()):
+        if (s >> i) & 1:
+            q = _add(q, table[i])
+    return q
+
+
+def _pow2_table(p, n):
+    out = []
+    for _ in range(n):
+        out.append(p)
+        p = _add(p, p)
+    return out
+
+
+# scalars are < 2^255 (the clamped secret sets bit 254; r/s/k are < L)
+_BASE_POW2 = _pow2_table(BASE, 256)
+
+
+@functools.lru_cache(maxsize=512)
+def _pubkey_pow2(public: bytes):
+    """Doubles table for a signer's point: gossip re-verifies the same
+    few keys under ever-new messages, so the k*A multiply amortizes to
+    adds-only after one verify per key. Bounded — eviction just
+    rebuilds. Raises ValueError on an invalid encoding (caller
+    handles)."""
+    return tuple(_pow2_table(_decompress(public), 256))
 
 
 def _compress(p) -> bytes:
@@ -81,7 +116,10 @@ class SigningKey:
     def generate(seed_material: bytes) -> "SigningKey":
         return SigningKey(hashlib.sha256(seed_material).digest())
 
-    @property
+    # cached_property stores via __dict__, which a frozen dataclass
+    # allows — both are pure functions of the immutable seed, and a
+    # long-lived node key signs every block/vote it authors
+    @functools.cached_property
     def _expanded(self) -> tuple[int, bytes]:
         h = hashlib.sha512(self.seed).digest()
         a = int.from_bytes(h[:32], "little")
@@ -89,7 +127,7 @@ class SigningKey:
         a |= 1 << 254
         return a, h[32:]
 
-    @property
+    @functools.cached_property
     def public(self) -> bytes:
         a, _ = self._expanded
         return _compress(_mul(a))
@@ -126,7 +164,7 @@ def _verify(public: bytes, message: bytes, signature: bytes) -> bool:
     if len(signature) != 64 or len(public) != 32:
         return False
     try:
-        a_pt = _decompress(public)
+        a_tab = _pubkey_pow2(public)
         r_pt = _decompress(signature[:32])
     except ValueError:
         return False
@@ -136,5 +174,5 @@ def _verify(public: bytes, message: bytes, signature: bytes) -> bool:
     k = _h(signature[:32] + public + message) % L
     # s*B == R + k*A  (check via compression to avoid projective compare)
     lhs = _mul(s)
-    rhs = _add(r_pt, _mul(k, a_pt))
+    rhs = _add(r_pt, _mul_tab(k, a_tab))
     return _compress(lhs) == _compress(rhs)

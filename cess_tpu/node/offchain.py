@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import codec, constants
+from ..obs import flight as _flight
 from ..obs import trace
 from ..resilience import faults
 from ..chain.file_bank import UserBrief
@@ -200,22 +201,45 @@ class MinerAgent:
         # repair go through its prove/repair queues — concurrent miners
         # answering the same round coalesce into shared device batches.
         # None (default) keeps the direct synchronous path.
-        self.engine = engine
-        if engine is not None and engine.codec is not None \
-                and (engine.codec.k, engine.codec.m) \
-                != (pipeline.config.k, pipeline.config.m):
-            # loud at construction, like StoragePipeline/TeeAgent — a
-            # mismatched codec would feed repair wrong shard geometry
-            raise ValueError(
-                f"engine codec RS({engine.codec.k},{engine.codec.m}) != "
-                f"miner pipeline RS({pipeline.config.k},"
-                f"{pipeline.config.m})")
+        self.engine = None
+        if engine is not None:
+            self.attach_engine(engine)
+        # repair dispatch mode (ops/regen.py): "fragments" fetches k
+        # whole survivor rows per repair; "symbols" walks the
+        # product-matrix repair-symbol chain through the helpers so
+        # only the final fragment-sized aggregate is ingressed.
+        self.repair_mode = "fragments"
+        # ingress accounting: every repair is charged by the bytes
+        # that crossed the wire INTO this miner vs the bytes it
+        # recovered — the regenerating claim is ingress/recovered ~ 1
+        # against the whole-fragment baseline of k (sim invariant
+        # "repair-ingress-bound", bench ingress_bytes_per_recovered_byte)
+        self.repair_ingress_bytes = 0
+        self.repair_recovered_bytes = 0
+        self.repair_symbol_repairs = 0
+        self.repair_whole_repairs = 0
+        self.repair_fallbacks = 0
         self.store: dict[bytes, bytes] = {}        # fragment hash -> bytes
         self.tags: dict[bytes, np.ndarray] = {}
         self.filler_store: dict[bytes, bytes] = {}  # filler hash -> bytes
         self.filler_tags: dict[bytes, np.ndarray] = {}
         self._reported: set[bytes] = set()
         self._proved_round: int = -1
+
+    def attach_engine(self, engine) -> None:
+        """Bind a submission engine, geometry-checked: a mismatched
+        codec would feed repair wrong shard geometry, so this is loud —
+        like StoragePipeline/TeeAgent — whether it happens at
+        construction or late (the sim's repair storm attaches the pool
+        engine to rescuers that were built without one)."""
+        if engine is not None and engine.codec is not None \
+                and (engine.codec.k, engine.codec.m) \
+                != (self.pipeline.config.k, self.pipeline.config.m):
+            raise ValueError(
+                f"engine codec RS({engine.codec.k},{engine.codec.m}) != "
+                f"miner pipeline RS({self.pipeline.config.k},"
+                f"{self.pipeline.config.m})")
+        self.engine = engine
 
     # -- fillers -----------------------------------------------------------------
     def setup_fillers(self, tee: "TeeAgent", count: int) -> None:
@@ -355,7 +379,13 @@ class MinerAgent:
             present = tuple(j for j in range(rows) if j != row)[:cfg.k]
             patterns.append((present, (row,)))
         if self.engine is not None and self.engine.codec is not None:
-            self.engine.warm_repair(patterns, cfg.fragment_size)
+            # restoral repairs are single-order blocking submits, so
+            # only the 1-row bucket's programs are ever dispatched —
+            # warming bucket 2 as well would double the AOT compile
+            # sweep (per pattern x per lane) for programs a repair
+            # never hits
+            self.engine.warm_repair(patterns, cfg.fragment_size,
+                                    buckets=(1,))
             return
         from ..ops.rs import make_codec
 
@@ -367,11 +397,100 @@ class MinerAgent:
             for present, missing in patterns:
                 warm(present, missing, (cfg.k, cfg.fragment_size))
 
+    def repair_symbol(self, frag_hash: bytes, coeff: int,
+                      acc: np.ndarray | None = None) -> np.ndarray | None:
+        """Helper side of a regenerating repair (ops/regen.py): fold
+        THIS miner's survivor fragment into the partial-sum chain,
+        acc ^ coeff*fragment, and return the fragment-sized aggregate
+        for the next helper (or the rebuilder, on the last hop).
+        Returns None when this helper can't serve — fragment not held,
+        or the transfer dropped (seam "offchain.symbol"). The outgoing
+        aggregate rides the "offchain.symbol_bytes" corruption seam;
+        integrity is the REBUILDER's hash check, exactly as for
+        whole-fragment transfers."""
+        blob = self.store.get(frag_hash)
+        if blob is None:
+            return None
+        if not faults.allow("offchain.symbol"):
+            return None
+        frag = np.frombuffer(blob, dtype=np.uint8)
+        acc = np.zeros_like(frag) if acc is None \
+            else np.asarray(acc, dtype=np.uint8)
+        if self.engine is not None and self.engine.codec is not None \
+                and hasattr(self.engine.codec, "fold_symbol"):
+            out = self.engine.repair_symbol(np.stack([acc, frag]),
+                                            int(coeff),
+                                            tenant=self.account)
+            sym = np.asarray(out)[0]
+        else:
+            from ..ops import regen
+
+            sym = regen.fold_symbol_host(acc, frag, int(coeff))
+        return np.asarray(faults.corrupt("offchain.symbol_bytes", sym),
+                          dtype=np.uint8)
+
+    def _repair_via_symbols(self, seg, row: int,
+                            present: tuple[int, ...],
+                            holders: dict[int, "MinerAgent"],
+                            cfg: PipelineConfig) -> bytes | None:
+        """Walk the product-matrix repair-symbol chain: each holder
+        folds coeff_j * fragment_j into the travelling partial sum, and
+        only the FINAL fragment-sized aggregate reaches this miner —
+        ingress n bytes for n recovered, vs k*n on the whole-fragment
+        path. Returns the (unverified) aggregate bytes, or None when
+        any hop refuses (the caller then falls back)."""
+        from ..ops import regen
+
+        try:
+            coeffs = regen.repair_coeffs(cfg.k, cfg.m, present, (row,))
+        except ValueError:
+            return None
+        acc = None
+        for j, coeff in zip(present, coeffs):
+            acc = holders[j].repair_symbol(seg.fragment_hashes[j],
+                                           int(coeff), acc)
+            if acc is None:
+                return None
+        # the aggregate crossed the wire whether or not it hashes
+        # clean — honest accounting charges it either way
+        self.repair_ingress_bytes += acc.nbytes
+        return acc.tobytes()
+
+    def _repair_via_fragments(self, seg, row: int,
+                              present: tuple[int, ...],
+                              holders: dict[int, "MinerAgent"],
+                              cfg: PipelineConfig) -> bytes:
+        """Whole-fragment dispatch: ingress k survivor rows and
+        reconstruct (engine repair queue when attached, direct codec
+        otherwise)."""
+        survivors = [np.frombuffer(
+            holders[j].store[seg.fragment_hashes[j]], dtype=np.uint8)
+            for j in present]
+        self.repair_ingress_bytes += sum(s.nbytes for s in survivors)
+        if self.engine is not None and self.engine.codec is not None:
+            rec = self.engine.reconstruct(np.stack(survivors),
+                                          present, (row,),
+                                          tenant=self.account)
+        else:
+            from ..ops.rs import make_codec
+
+            codec_ = make_codec(cfg.k, cfg.m, backend="auto")
+            rec = codec_.reconstruct(np.stack(survivors), present,
+                                     (row,))
+        return np.asarray(rec)[0].tobytes()
+
     def try_repair(self, frag_hash: bytes, peers: list["MinerAgent"],
                    gateways: list[OssGateway] | None = None) -> bool:
-        """Claim + repair a broken fragment via RS reconstruction from
-        peer-held rows, then report completion. The repaired bytes must
-        re-hash to the on-chain identity (byte-exact decode)."""
+        """Claim + repair a broken fragment from peer-held rows, then
+        report completion. ``repair_mode`` picks the dispatch:
+        "fragments" ingresses k whole survivor rows; "symbols" walks
+        the regenerating repair-symbol chain (ops/regen.py) and
+        ingresses one fragment-sized aggregate, falling back to the
+        whole-fragment path when a helper refuses or the aggregate
+        fails its hash (counted in ``repair_fallbacks`` and noted to
+        the flight recorder). EITHER WAY the repaired bytes must
+        re-hash to the on-chain identity before they are stored — a
+        bad decode is a failed repair, never poisoned storage."""
         rt = self.node.runtime
         order = rt.file_bank.restoral_order(frag_hash)
         if order is None:
@@ -382,38 +501,48 @@ class MinerAgent:
         seg = next(s for s in f.segments if frag_hash in s.fragment_hashes)
         row = seg.fragment_hashes.index(frag_hash)
         cfg = self.pipeline.config
-        survivors, present = [], []
+        holders: dict[int, MinerAgent] = {}
         for j, h in enumerate(seg.fragment_hashes):
             if j == row:
                 continue
             for peer in peers:
                 if h in peer.store:
-                    survivors.append(np.frombuffer(peer.store[h],
-                                                   dtype=np.uint8))
-                    present.append(j)
+                    holders[j] = peer
                     break
-            if len(present) == cfg.k:
+            if len(holders) == cfg.k:
                 break
-        if len(present) < cfg.k:
+        if len(holders) < cfg.k:
             return False
+        present = tuple(holders)
+        mode = self.repair_mode
+        via_symbols = False
         with trace.span("offchain.repair", sys="offchain",
                         miner=self.account, row=row,
-                        survivors=len(present)):
-            if self.engine is not None and self.engine.codec is not None:
-                rec = self.engine.reconstruct(np.stack(survivors),
-                                              tuple(present), (row,),
-                                              tenant=self.account)
-                blob = np.asarray(rec)[0].tobytes()
-            else:
-                from ..ops.rs import make_codec
-
-                codec = make_codec(cfg.k, cfg.m, backend="auto")
-                rec = codec.reconstruct(np.stack(survivors),
-                                        tuple(present), (row,))
-                blob = np.asarray(rec)[0].tobytes()
+                        survivors=len(present), mode=mode):
+            blob = None
+            if mode == "symbols":
+                blob = self._repair_via_symbols(seg, row, present,
+                                                holders, cfg)
+                if blob is not None and fragment_hash(blob) == frag_hash:
+                    via_symbols = True
+                else:
+                    self.repair_fallbacks += 1
+                    _flight.note("repair", "fallback",
+                                 miner=self.account, row=row,
+                                 reason="broken-chain" if blob is None
+                                 else "bad-hash")
+                    blob = None
+            if blob is None:
+                blob = self._repair_via_fragments(seg, row, present,
+                                                  holders, cfg)
         if fragment_hash(blob) != frag_hash:
             return False
         self.store[frag_hash] = blob
+        self.repair_recovered_bytes += len(blob)
+        if via_symbols:
+            self.repair_symbol_repairs += 1
+        else:
+            self.repair_whole_repairs += 1
         for peer in peers:
             if frag_hash in peer.tags:
                 self.tags[frag_hash] = peer.tags[frag_hash]

@@ -67,7 +67,8 @@ from .trace import _json_safe
 # journal reacts to host-timed p99 estimates, so it is evidence, not
 # witness)
 _CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
-                        "finality", "flight", "fleet", "perf", "chain"))
+                        "finality", "flight", "fleet", "perf", "chain",
+                        "repair"))
 
 # the chain anomaly classes obs/chainwatch.py announces; the journal
 # detail's ``cls`` IS the trigger class (one note kind, four triggers)
@@ -124,6 +125,11 @@ class IncidentReporter:
                    witness needed to replay the episode.
     max_per_class: bundles per trigger class (count-based rate limit).
     shed_storm:    consecutive engine sheds that constitute a storm.
+    repair_degraded: symbol-repair fallbacks (node/offchain.py journal
+                   notes ("repair", "fallback")) that constitute a
+                   degraded repair plane — the regenerating path has
+                   stopped engaging and every repair is paying the
+                   whole-fragment bandwidth bill.
     """
 
     def __init__(self, recorder, *, engine=None, board=None, plan=None,
@@ -131,8 +137,10 @@ class IncidentReporter:
                  context=None,
                  max_per_class: int = 4,
                  max_bundles: int = 32, shed_storm: int = 8,
+                 repair_degraded: int = 8,
                  journal_tail: int = 64):
-        if max_per_class < 1 or max_bundles < 1 or shed_storm < 1:
+        if max_per_class < 1 or max_bundles < 1 or shed_storm < 1 \
+                or repair_degraded < 1:
             raise ValueError("incident reporter bounds must be >= 1")
         self.recorder = recorder
         self.engine = engine
@@ -146,6 +154,7 @@ class IncidentReporter:
         self.context = context
         self.max_per_class = max_per_class
         self.shed_storm = shed_storm
+        self.repair_degraded = repair_degraded
         self.journal_tail = journal_tail
         self._mu = threading.Lock()
         self._bundles: collections.deque = collections.deque(
@@ -153,6 +162,7 @@ class IncidentReporter:
         self._per_class: dict = {}
         self._last_key: dict = {}
         self._shed_run = 0
+        self._repair_run = 0
         self._seq = 0
         self._last_metrics: dict = {}
         self.rate_limited = 0
@@ -173,6 +183,22 @@ class IncidentReporter:
                                  f"{detail.get('reason')}",
                              detail=dict(detail,
                                          storm=self.shed_storm))
+            return
+        if subsystem == "repair" and kind == "fallback":
+            # symbol-chain repairs falling back to whole-fragment
+            # fetch: each one is routine, a RUN of them means the
+            # regenerating plane is degraded (same accumulation shape
+            # as shed-storm)
+            with self._mu:
+                self._repair_run += 1
+                degraded = self._repair_run >= self.repair_degraded
+                if degraded:
+                    self._repair_run = 0
+            if degraded:
+                self.trigger("repair-degraded",
+                             key=str(detail.get("miner")),
+                             detail=dict(detail,
+                                         run=self.repair_degraded))
             return
         if subsystem == "slo" and kind == "transition":
             if detail.get("to") != "burning":

@@ -148,10 +148,20 @@ def systematic_generator(k: int, m: int) -> np.ndarray:
     )
 
 
+def _check_rows(k: int, m: int, rows: tuple[int, ...], what: str) -> None:
+    if len(set(rows)) != len(rows):
+        raise ValueError(f"duplicate {what} shard indices: {rows}")
+    for r in rows:
+        if not 0 <= int(r) < k + m:
+            raise ValueError(f"{what} shard index {r} out of range for "
+                             f"RS({k},{m}) with {k + m} rows")
+
+
 def decode_matrix(k: int, m: int, present: tuple[int, ...]) -> np.ndarray:
     """Matrix R s.t. data = R @ shards[present] for any k present shard rows."""
     if len(present) != k:
         raise ValueError(f"need exactly k={k} present shard indices, got {len(present)}")
+    _check_rows(k, m, present, "present")
     g = systematic_generator(k, m)
     sub = g[list(present)]
     return gf_mat_inv(sub)
@@ -160,6 +170,7 @@ def decode_matrix(k: int, m: int, present: tuple[int, ...]) -> np.ndarray:
 def repair_matrix(k: int, m: int, present: tuple[int, ...],
                   missing: tuple[int, ...]) -> np.ndarray:
     """Matrix M s.t. shards[missing] = M @ shards[present]."""
+    _check_rows(k, m, missing, "missing")
     g = systematic_generator(k, m)
     inv = decode_matrix(k, m, present)
     return gf_matmul(g[list(missing)], inv)

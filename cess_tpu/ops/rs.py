@@ -328,7 +328,8 @@ def make_codec(k: int, m: int, backend: str = "cpu", strategy: Strategy | None =
     Mirrors the north-star design (BASELINE.json): erasure coding is
     gated behind a codec trait with the CPU reference implementation as
     default and the JAX/TPU path selectable. backend: "cpu" | "native"
-    (C++ via ctypes) | "tpu"/"jax" | "auto" (tpu if a TPU is present).
+    (C++ via ctypes) | "tpu"/"jax" | "regen" (regenerating-code repair
+    plane, ops/regen.py) | "auto" (tpu if a TPU is present).
     """
     if backend == "auto":
         backend = "tpu" if jax.default_backend() != "cpu" else "cpu"
@@ -347,4 +348,10 @@ def make_codec(k: int, m: int, backend: str = "cpu", strategy: Strategy | None =
         return NativeCodec(k, m)
     if backend in ("tpu", "jax"):
         return TPUCodec(k, m, strategy=strategy)
+    if backend == "regen":
+        # regenerating-code repair plane (ops/regen.py); imported lazily
+        # because regen builds on this module
+        from .regen import RegenCodec
+
+        return RegenCodec(k, m, strategy=strategy)
     raise ValueError(f"unknown ErasureCodec backend {backend!r}")

@@ -256,10 +256,19 @@ class SubmissionEngine:
         if resilience is not None:
             self.stats.resilience = resilience.stats
             if codec is not None:
-                from ..ops import rs as _rs
+                if hasattr(codec, "fold_symbol"):
+                    # regenerating codec: degrade onto ITS reference
+                    # twin so the symbol surface survives a breaker
+                    # trip (same bytes, host placement)
+                    from ..ops.regen import RegenReference
 
-                self._fallback_codec = _rs.make_codec(codec.k, codec.m,
-                                                      backend="cpu")
+                    self._fallback_codec = RegenReference(codec.k,
+                                                          codec.m)
+                else:
+                    from ..ops import rs as _rs
+
+                    self._fallback_codec = _rs.make_codec(
+                        codec.k, codec.m, backend="cpu")
                 self.monitors["codec"] = resilience.monitor()
             if audit is not None:
                 from ..ops import audit_backend as _ab
@@ -361,6 +370,34 @@ class SubmissionEngine:
                     tenant: str | None = None) -> np.ndarray:
         return self._blocking("repair", self.submit_decode_data,
                               survivors, present, timeout=timeout,
+                              tenant=tenant)
+
+    def submit_repair_symbol(self, pairs, coeff: int,
+                             timeout: float | None = None,
+                             tenant: str | None = None) -> EngineFuture:
+        """pairs [B, 2, n] (or [2, n]) uint8 (accumulator, fragment)
+        rows -> future of the folded [B, 1, n] partial sums
+        (acc ^ coeff*fragment) — the helper hop of the regenerating
+        repair chain (ops/regen.py). Needs a codec with the symbol
+        surface (``make_engine(..., rs_backend="regen")``); a
+        breaker-degraded batch serves from the host twin."""
+        self._need_codec()
+        if not hasattr(self.codec, "fold_symbol"):
+            raise ValueError(
+                "repair symbols need a regenerating codec; build the "
+                "engine with rs_backend='regen'")
+        coeff = int(coeff)
+        pairs, squeeze = self._norm_shards(pairs, 2)
+        key = ("repair", "symbol", (coeff,), (), pairs.shape[2])
+        return self._submit("repair", key, pairs.shape[0],
+                            {"survivors": pairs}, {"coeff": coeff},
+                            timeout, squeeze, tenant=tenant)
+
+    def repair_symbol(self, pairs, coeff: int,
+                      timeout: float | None = None,
+                      tenant: str | None = None) -> np.ndarray:
+        return self._blocking("repair", self.submit_repair_symbol,
+                              pairs, coeff, timeout=timeout,
                               tenant=tenant)
 
     # -- tag (AuditBackend, TEE role) ----------------------------------
@@ -552,6 +589,39 @@ class SubmissionEngine:
                         lambda p=present, mi=missing:
                             (lambda a: self.codec.reconstruct(a, p,
                                                               mi)))
+        # regen leg: when the codec carries the symbol surface
+        # (RegenCodec.warm_fold), warm the helper-fold programs for
+        # every coefficient the single-missing patterns can ask for —
+        # same base + per-lane key discipline as the reconstructs, so
+        # a symbol chain fanned across lanes never pays compile time
+        warm_fold = getattr(self.codec, "warm_fold", None)
+        if warm_fold is None:
+            return
+        from ..ops import regen
+
+        coeffs: set[int] = set()
+        for present, missing in patterns:
+            present, missing = tuple(present), tuple(missing)
+            if len(missing) != 1:
+                continue
+            coeffs.update(regen.repair_coeffs(
+                self.codec.k, self.codec.m, present, missing))
+        coeffs.discard(0)
+        for c in sorted(coeffs):
+            for b in buckets:
+                bucket = bucket_rows(b)
+                warm_fold(c, (bucket, 2, n))
+                self.programs.get(
+                    ("symbol", c, n, bucket),
+                    lambda cc=c:
+                        (lambda a: self.codec.fold_symbol(a, cc)))
+                for lane in lanes:
+                    warm_fold(c, (bucket, 2, n), device=lane.device)
+                    self.programs.get(
+                        self._key(("symbol", c, n, bucket), False,
+                                  lane),
+                        lambda cc=c:
+                            (lambda a: self.codec.fold_symbol(a, cc)))
 
     def attach_stream(self, stream_stats) -> None:
         """Register a streaming driver's StreamStats so its per-stage
@@ -1083,6 +1153,12 @@ class SubmissionEngine:
                         # per-lane seam: chaos plans kill ONE lane's
                         # dispatch while its siblings stay healthy
                         faults.inject(f"engine.dispatch.d{lane.index}")
+                        # per-class lane seam: a plan can trip ONE
+                        # class's dispatches on one lane (the repair
+                        # storm trips repair lane 0 mid-storm while
+                        # the same lane keeps serving uploads)
+                        faults.inject(
+                            f"engine.dispatch.{cls}.d{lane.index}")
                 # two-arg call off the pool path: the (batch, degraded)
                 # runner signature is a public monkeypatch seam
                 results, device_rows = (
@@ -1361,6 +1437,20 @@ class SubmissionEngine:
                           degraded, lane),
                 lambda: (lambda a: codec.reconstruct(a, present,
                                                      missing)))
+        elif kind == "symbol":
+            coeff = aux["coeff"]
+            fold = getattr(codec, "fold_symbol", None)
+            if fold is None:
+                # breaker-degraded (or plain-reference fallback) codec:
+                # serve the fold from the host twin — the chain stays
+                # bit-identical, only the placement degrades
+                from ..ops import regen
+
+                fold = regen.fold_symbol_pairs
+            prog = self.programs.get(
+                self._key(("symbol", coeff, n, bucket), degraded,
+                          lane),
+                lambda f=fold, c=coeff: (lambda a: f(a, c)))
         else:
             present = aux["present"]
             prog = self.programs.get(

@@ -199,6 +199,86 @@ def check_restoral_single_winner(world) -> list[str]:
     return out
 
 
+def check_repair_exactly_once(world) -> list[str]:
+    """Every fragment the restoral market completed was recovered
+    EXACTLY once — one completion event, one winner — and the winner
+    (when its home is still alive) holds bytes re-hashing to the
+    on-chain identity. Double completion means double pay; a winner
+    without verified bytes means the market paid for a repair that
+    never happened."""
+    rt = _ref_runtime(world)
+    if rt is None or getattr(world, "storage", None) is None:
+        return []
+    homes = getattr(world, "role_homes", {})
+    completions: dict[bytes, list[str]] = {}
+    for e in rt.state.events_of("file_bank", "RestoralComplete"):
+        d = dict(e.data)
+        completions.setdefault(d["fragment_hash"], []).append(d["miner"])
+    out = []
+    for frag, accounts in sorted(completions.items()):
+        if len(accounts) != 1:
+            out.append(
+                f"repair-exactly-once: fragment {frag.hex()[:12]} "
+                f"completed {len(accounts)} times by "
+                f"{sorted(set(accounts))}")
+            continue
+        agent = world.agents.get(accounts[0])
+        home = homes.get(accounts[0])
+        if agent is None or home is None or not world.alive[home]:
+            continue
+        blob = agent.store.get(frag)
+        if blob is None:
+            out.append(
+                f"repair-exactly-once: winner {accounts[0]} of "
+                f"fragment {frag.hex()[:12]} no longer holds it")
+        elif fragment_hash(blob) != frag:
+            out.append(
+                f"repair-exactly-once: winner {accounts[0]} holds "
+                f"corrupt bytes for fragment {frag.hex()[:12]}")
+    return out
+
+
+def check_repair_ingress_bound(world) -> list[str]:
+    """When symbol-mode repairs ran, fleet-wide repair ingress must
+    beat the whole-fragment baseline of k bytes per recovered byte —
+    if the regenerating path silently stopped engaging (every repair
+    fell back), this trips instead of the saving quietly vanishing."""
+    storage = getattr(world, "storage", None)
+    if storage is None:
+        return []
+    miners = getattr(world, "miners", ())
+    if not any(getattr(m, "repair_mode", "") == "symbols"
+               for m in miners):
+        return []
+    recovered = sum(m.repair_recovered_bytes for m in miners)
+    ingress = sum(m.repair_ingress_bytes for m in miners)
+    if recovered == 0:
+        return []
+    if ingress >= storage.k * recovered:
+        return [
+            f"repair-ingress-bound: {ingress} ingress bytes for "
+            f"{recovered} recovered — not below the whole-fragment "
+            f"baseline of {storage.k} bytes/byte (regenerating repair "
+            f"never engaged?)"]
+    return []
+
+
+def check_repair_drained(world) -> list[str]:
+    """Storm final check: the restoral market fully drained — no
+    order still open anywhere on the reference chain view."""
+    rt = _ref_runtime(world)
+    if rt is None or getattr(world, "storage", None) is None:
+        return []
+    out = []
+    for (frag,), order in sorted(
+            rt.state.iter_prefix("file_bank", "restoral")):
+        out.append(
+            f"repair-drained: restoral order for fragment "
+            f"{frag.hex()[:12]} still open "
+            f"(claimed by {order.miner or 'nobody'})")
+    return out
+
+
 def check_fleet_consistency(world) -> list[str]:
     """Global fleet state must be DERIVABLE from per-node states: the
     FleetBoard's worst/quorum views recomputed from the node states it
@@ -255,6 +335,9 @@ CHECKERS = {
     "storage-convergence": check_storage_convergence,
     "heads-converged": check_heads_converged,
     "restoral-single-winner": check_restoral_single_winner,
+    "repair-exactly-once": check_repair_exactly_once,
+    "repair-ingress-bound": check_repair_ingress_bound,
+    "repair-drained": check_repair_drained,
     "fleet-consistency": check_fleet_consistency,
 }
 

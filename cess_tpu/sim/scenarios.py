@@ -95,10 +95,16 @@ class Scenario:
     slo: tuple = (("round", 4.0), ("upload", 4.0))
     checks: tuple = ("finalized-prefix", "vote-locks")
     final_checks: tuple = ()
-    pool: bool = False
+    # False = no engine; True = pool over all visible devices; an int
+    # caps the lane count (make_engine(pool=N))
+    pool: bool | int = False
     fleet: bool = False
     profile: bool = False
     chainwatch: bool = False
+    # with ``pool``: build the engine on the regenerating codec
+    # (ops/regen.py, rs_backend="regen") so storm_repair rescuers run
+    # symbol-mode repairs and the fold programs ride the lane caches
+    regen: bool = False
 
 
 def resolve_ref(world: World, ref: str) -> int:
@@ -269,6 +275,62 @@ def _apply_action(world: World, pending: dict, rnd: int,
             return
         raise LookupError(f"drop_fragment: no active file with a "
                           f"stored row-{row} fragment")
+    elif action == "storm_kill":
+        # mass miner failure: drop EVERY active-file fragment the
+        # victim ordinals custody, open their restoral orders via the
+        # (alive) gateway node, then crash the victims' home nodes —
+        # the restoral market floods with concurrent orders at once
+        start, count = args
+        rt = world.gateways[0].node.runtime
+        frag_file: dict[bytes, bytes] = {}
+        for (fh,), f in sorted(rt.state.iter_prefix("file_bank", "file")):
+            if f.state != "active":
+                continue
+            for seg in f.segments:
+                for h in seg.fragment_hashes:
+                    frag_file[h] = fh
+        owner = {frag: acct for (acct, frag), _e
+                 in rt.state.iter_prefix("file_bank", "frag_of_miner")}
+        gw_node = world.gateways[0].node
+        for j in range(start, start + count):
+            victim = world.agents[f"m{j}"]
+            dropped = 0
+            for h in sorted(frag_file):
+                if owner.get(h) != victim.account:
+                    continue
+                victim.store.pop(h, None)
+                victim.tags.pop(h, None)
+                gw_node.submit_extrinsic(
+                    victim.account, "file_bank.generate_restoral_order",
+                    frag_file[h], h)
+                dropped += 1
+            world.crash(world.role_homes[victim.account])
+            world.queue.mark(f"storm_kill:{victim.account}:{dropped}")
+    elif action == "storm_repair":
+        # surviving miners fan the open orders across the pool engine:
+        # first pass binds each alive rescuer to the scenario engine
+        # (symbol mode when it carries the regenerating codec) and
+        # warms the restoral patterns per lane, then every rescuer
+        # sweeps the market — concurrent claims are the storm load
+        eng = getattr(world.pipeline, "engine", None)
+        repaired = 0
+        for rescuer in world.miners:
+            if not world.alive[world.role_homes[rescuer.account]]:
+                continue
+            if eng is not None and rescuer.engine is None:
+                rescuer.attach_engine(eng)
+                if hasattr(eng.codec, "fold_symbol"):
+                    rescuer.repair_mode = "symbols"
+                rescuer.warm_restoral()
+            rt = rescuer.node.runtime
+            for (frag,), order in sorted(
+                    rt.state.iter_prefix("file_bank", "restoral")):
+                if order.miner or order.origin_miner == rescuer.account:
+                    continue
+                if rescuer.try_repair(frag, world.miners,
+                                      world.gateways):
+                    repaired += 1
+        world.queue.mark(f"storm_repair:{repaired}")
     elif action == "repair_contend":
         # every OTHER miner sees the same open orders and races: all
         # reconstruct, all claim — the chain pays exactly ONE (the
@@ -406,13 +468,17 @@ def _chainwatch_scrape(world: World, watch, rnd: int) -> None:
     watch.seal_round()
 
 
-def _pool_engine(world: World, profile: bool = False):
+def _pool_engine(world: World, profile: bool = False,
+                 regen: bool = False, lanes=True):
     """A device-pool submission engine matched to the world's storage
     pipeline: same RS geometry, same PoDR2 key (a mismatched key would
     tag with different secrets than the direct path), all visible
-    devices, breakers enabled so lane faults trip and drain. With
-    ``profile``, an unanchored ProfilePlane rides along (no bench
-    baseline inside a sim world — ledgers fill, watchdog inert)."""
+    devices (``lanes=N`` caps the pool width — the repair storm's
+    per-lane AOT warm sweep scales with lane count, and a lane trip +
+    sibling drain needs few lanes, not all of them), breakers enabled
+    so lane faults trip and drain. With ``profile``, an unanchored
+    ProfilePlane rides along (no bench baseline inside a sim world —
+    ledgers fill, watchdog inert)."""
     from ..resilience import ResilienceConfig
     from ..serve import make_engine
 
@@ -422,9 +488,10 @@ def _pool_engine(world: World, profile: bool = False):
 
         plane = ProfilePlane()
     pipe = world.pipeline
-    return make_engine(pipe.config.k, pipe.config.m, rs_backend="jax",
+    return make_engine(pipe.config.k, pipe.config.m,
+                       rs_backend="regen" if regen else "jax",
                        podr2_key=pipe.podr2_key,
-                       resilience=ResilienceConfig(), pool=True,
+                       resilience=ResilienceConfig(), pool=lanes,
                        profile=plane)
 
 
@@ -486,7 +553,9 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 # sim thread, so placement (and the fault plan's
                 # per-site ordinals) replay deterministically; the
                 # snapshot is captured before the engine closes.
-                eng = _pool_engine(world, profile=scenario.profile)
+                eng = _pool_engine(world, profile=scenario.profile,
+                                   regen=scenario.regen,
+                                   lanes=scenario.pool)
                 profile_plane = eng.profile
                 stack.callback(eng.close)
                 stack.callback(lambda: pool_snap.update(
@@ -717,6 +786,38 @@ SCENARIOS: dict[str, Scenario] = {
         checks=("finalized-prefix", "vote-locks",
                 "fleet-consistency"),
         final_checks=("heads-converged",),
+    ),
+    # the repair plane's mass-failure drill (ISSUE 15): a wide
+    # RS(2, 2) storage plane takes 6 uploads, then TWO miners die at
+    # once — every fragment they custody floods the restoral market in
+    # one round. The surviving miners bind to the pool engine's
+    # REGENERATING codec (ops/regen.py), warm the per-lane repair +
+    # fold programs, and sweep the market concurrently in symbol mode
+    # (one fragment-sized aggregate ingressed per repair instead of k
+    # fragments), while a seeded fault trips every repair-class
+    # dispatch on lane 0 mid-storm — the lane's breaker opens (the
+    # armed incident reporter captures the bundle), repairs drain
+    # through the sibling lanes, and the market still pays exactly one
+    # winner per fragment. The repair-* invariants pin it: every
+    # completion exactly once with verified bytes, fleet ingress below
+    # the whole-fragment baseline, no order left open at the end.
+    "repair_storm": Scenario(
+        name="repair_storm", rounds=14, pool=3, regen=True,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 6), ("k", 2), ("m", 2)))),
+        timeline=(
+            (1, "upload", 0, "alice", 16_000, 3),
+            (2, "upload", 0, "alice", 16_000, 3),
+            (9, "storm_kill", 1, 2),
+            (10, "storm_repair"),
+            (11, "storm_repair"),
+        ),
+        faults=(("engine.dispatch.repair.d0", 1.0, "raise"),),
+        checks=("finalized-prefix", "vote-locks",
+                "repair-exactly-once"),
+        final_checks=("restoral-single-winner", "repair-exactly-once",
+                      "repair-ingress-bound", "repair-drained",
+                      "storage-convergence"),
     ),
     # a miner loses a fragment; TWO non-assigned rescuers race the
     # restoral order — both reconstruct, the market pays exactly one

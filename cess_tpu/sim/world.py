@@ -491,6 +491,11 @@ class StorageProfile:
     buy_gib: int = 1
     segment_size: int = 16 * 1024
     adversarial_miners: tuple = ()
+    # RS geometry of the storage pipeline. The default matches the
+    # live storage-net tests; the repair storm widens to (2, 2) so a
+    # batch miner kill leaves every segment k-recoverable.
+    k: int = 2
+    m: int = 1
 
     def endowments(self) -> list[tuple[str, int]]:
         out = [("tee0", 1_000 * D), ("stash0", 10_000_000 * D)]
@@ -499,8 +504,12 @@ class StorageProfile:
         return out
 
     def spec_overrides(self) -> dict:
-        # the tight audit cadence the live storage-net tests run under
-        return {"audit_challenge_life": 6, "audit_verify_life": 8}
+        # the tight audit cadence the live storage-net tests run under;
+        # fragment_count tracks the profile's RS geometry so deals
+        # assign one distinct miner per row (k + m = 3 at the defaults
+        # == constants.FRAGMENT_COUNT: zero change unless overridden)
+        return {"audit_challenge_life": 6, "audit_verify_life": 8,
+                "fragment_count": self.k + self.m}
 
     def _place_roles(self, world: World) -> dict[str, int]:
         """Seed-drawn home nodes for every storage role, preferring
@@ -527,7 +536,8 @@ class StorageProfile:
                                      ValidatorOcw)
         from ..ops import podr2
 
-        cfg = PipelineConfig(k=2, m=1, segment_size=self.segment_size)
+        cfg = PipelineConfig(k=self.k, m=self.m,
+                             segment_size=self.segment_size)
         key = podr2.Podr2Key.generate(7)
         pipe = StoragePipeline(cfg, podr2_key=key)
         world.pipeline = pipe

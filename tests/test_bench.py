@@ -32,6 +32,8 @@ EXPECTED = (
     "fleet_federate_100nodes_ms",
     "stream_encode_tag_profiled_GiBps",
     "chainwatch_100node_scan_ms",
+    "repair_storm_drain_s",
+    "ingress_bytes_per_recovered_byte",
 )
 
 
@@ -141,6 +143,18 @@ def test_bench_smoke_every_metric_finite():
     assert cw["n_nodes"] == 100
     assert cw["equivocations"] >= 1 and cw["anomalies"] >= 1
     assert cw["miners"] >= 1
+    # the repair-storm metrics (ISSUE 15): a batch miner kill drained
+    # through the regenerating repair plane — every order cleared via
+    # symbol chains, and the measured ingress per recovered byte beats
+    # the k=2 whole-fragment baseline
+    storm = got["repair_storm_drain_s"]
+    assert storm["orders"] >= 1 and storm["symbol_repairs"] >= 1
+    assert storm["fallbacks"] == 0
+    assert storm["recovered_bytes"] > 0
+    ing = got["ingress_bytes_per_recovered_byte"]
+    assert ing["baseline_bytes_per_byte"] == 2.0
+    assert ing["value"] < ing["baseline_bytes_per_byte"]
+    assert ing["ingress_bytes"] < 2 * ing["recovered_bytes"]
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:
@@ -209,6 +223,13 @@ class TestBenchDiff:
         assert not bench_diff.lower_is_better(
             "podr2_100k_tag_verify_frags_per_s")
         assert not bench_diff.lower_is_better("stream_encode_tag_GiBps")
+        # ISSUE 15 satellite: the repair-cost ratio regresses UPWARD,
+        # and adding it must not flip any _per_s rate
+        assert bench_diff.lower_is_better(
+            "ingress_bytes_per_recovered_byte")
+        assert bench_diff.lower_is_better("repair_storm_drain_s")
+        assert not bench_diff.lower_is_better(
+            "repair_storm_orders_per_s")
 
     def test_default_against_is_the_next_lower_round(self, tmp_path,
                                                       monkeypatch):

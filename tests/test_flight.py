@@ -322,6 +322,22 @@ class TestIncidentTriggers:
         assert b["key"] == "encode:slo-burning"
         assert b["detail"]["storm"] == 3
 
+    def test_repair_degraded_counts_fallback_run(self):
+        """ISSUE 15: a RUN of symbol-repair fallbacks (the journal
+        notes MinerAgent.try_repair leaves behind) is the incident —
+        a single fallback is routine."""
+        rec, rep = _pair(repair_degraded=3)
+        for _ in range(2):
+            rec.note("repair", "fallback", miner="m3", row=1,
+                     reason="broken-chain")
+        assert rep.bundles() == []          # below the threshold
+        rec.note("repair", "fallback", miner="m3", row=2,
+                 reason="bad-hash")
+        (b,) = rep.bundles()
+        assert b["trigger"] == "repair-degraded"
+        assert b["key"] == "m3"
+        assert b["detail"]["run"] == 3
+
     def test_invariant_and_thread_escape_triggers(self):
         rec, rep = _pair()
         rec.note("sim", "invariant", context="s:round1", violations=["x"])

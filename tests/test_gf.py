@@ -93,3 +93,58 @@ def test_expanded_bitmatrix_matmul():
     obits = (mbits.astype(np.int32) @ dbits.astype(np.int32)) & 1  # [8r, n]
     got = (obits.reshape(r, 8, n) << np.arange(8)[None, :, None]).sum(axis=1).astype(np.uint8)
     assert np.array_equal(got, want)
+
+
+# -- decode_matrix edge patterns (ISSUE 15) ---------------------------------
+
+def _encode(k, m, data):
+    g = gf.systematic_generator(k, m)
+    return gf.gf_matmul(g, data)
+
+
+@pytest.mark.parametrize("k,m", [(2, 2), (3, 3), (4, 8)])
+def test_decode_matrix_all_parity_survivors(k, m):
+    """The extreme pattern: every data row lost, decode runs entirely
+    from parity rows."""
+    present = tuple(range(k, 2 * k))
+    data = np.random.default_rng(k).integers(0, 256, (k, 48)).astype(np.uint8)
+    coded = _encode(k, m, data)
+    r = gf.decode_matrix(k, m, present)
+    assert np.array_equal(gf.gf_matmul(r, coded[list(present)]), data)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 8), (10, 4)])
+def test_decode_matrix_minimal_survivor_sets(k, m):
+    """Exactly-k survivor sets at both ends of the row space (the
+    first k rows, the last k rows) and a mixed stride decode cleanly."""
+    data = np.random.default_rng(k + m).integers(0, 256, (k, 32)).astype(np.uint8)
+    coded = _encode(k, m, data)
+    rows = k + m
+    for present in (tuple(range(k)),               # all-data identity
+                    tuple(range(rows - k, rows)),  # tail-heavy
+                    tuple(range(0, rows, max(1, rows // k)))[:k]):
+        r = gf.decode_matrix(k, m, present)
+        assert np.array_equal(gf.gf_matmul(r, coded[list(present)]), data), present
+
+
+def test_decode_matrix_refuses_wrong_survivor_count():
+    with pytest.raises(ValueError, match="exactly k=4"):
+        gf.decode_matrix(4, 2, (0, 1, 2))
+    with pytest.raises(ValueError, match="exactly k=4"):
+        gf.decode_matrix(4, 2, (0, 1, 2, 3, 4))
+
+
+def test_decode_matrix_refuses_malformed_patterns():
+    with pytest.raises(ValueError, match="duplicate present"):
+        gf.decode_matrix(2, 2, (1, 1))
+    with pytest.raises(ValueError, match="out of range"):
+        gf.decode_matrix(2, 2, (0, 4))
+    with pytest.raises(ValueError, match="out of range"):
+        gf.decode_matrix(2, 2, (0, -1))
+
+
+def test_repair_matrix_refuses_malformed_missing():
+    with pytest.raises(ValueError, match="duplicate missing"):
+        gf.repair_matrix(2, 2, (0, 1), (3, 3))
+    with pytest.raises(ValueError, match="out of range"):
+        gf.repair_matrix(2, 2, (0, 1), (4,))

@@ -691,6 +691,48 @@ class TestSimDeterminism:
         assert lint(CLEAN_SIM,
                     "cess_tpu/obs/chainwatch.py").findings == []
 
+    def test_regen_repair_plane_joins_the_family(self):
+        """ISSUE 15: the regenerating repair plane's coefficient and
+        matrix constructions feed the repair storm's replay contract,
+        so ops/regen.py joins the determinism AND lock-discipline
+        families — while the rest of ops/ (pure device math with no
+        shared caches) stays exempt from both."""
+        assert rules_at(
+            lint(DIRTY_SIM, "cess_tpu/ops/regen.py")) == \
+            {"sim-wallclock", "sim-entropy"}
+        assert lint(CLEAN_SIM, "cess_tpu/ops/regen.py").findings == []
+        assert "lock-unguarded-write" in rules_at(
+            lint(DIRTY_LOCK, "cess_tpu/ops/regen.py"))
+        # the lock-clean twin sleeps outside the lock, which the
+        # (also-applying) sim family flags — so assert only that no
+        # lock-family rule fires at the regen path
+        assert not any(
+            r.startswith("lock-")
+            for r in rules_at(lint(CLEAN_LOCK, "cess_tpu/ops/regen.py")))
+        # other ops modules do NOT inherit the two borrowed families
+        assert lint(DIRTY_SIM, "cess_tpu/ops/fixture.py").findings == []
+        assert lint(DIRTY_LOCK, "cess_tpu/ops/fixture.py").findings == []
+
+    def test_regen_module_scans_clean_under_every_family(self):
+        """ISSUE 15 satellite: the shipped ops/regen.py passes
+        trace-safety, lock-discipline, span-balance AND the sim
+        determinism family with zero suppressions; the dirty twins
+        prove each family really fires at that path, and the baseline
+        stays empty."""
+        for dirty, rule in ((DIRTY_TRACE, "trace-print"),
+                            (DIRTY_LOCK, "lock-unguarded-write"),
+                            (DIRTY_SPAN, "span-balance"),
+                            (DIRTY_SIM, "sim-wallclock")):
+            assert rule in rules_at(
+                lint(dirty, "cess_tpu/ops/regen.py")), rule
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "ops", "regen.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_chainwatch_module_scans_clean_under_every_family(self):
         """ISSUE 14 satellite: the shipped obs/chainwatch.py passes
         trace-safety, lock-discipline, span-balance AND the sim

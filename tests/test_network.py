@@ -418,3 +418,113 @@ def test_ocw_mines_unsigned_election_solution():
     assert rt.election.result()          # a non-empty authority set
     roots = {n.runtime.state.state_root() for n in nodes}
     assert len(roots) == 1
+
+
+def _break_fragment(node, miners, row):
+    """Delete one active file's row-``row`` fragment from whichever
+    miner holds it and open its restoral order. Returns (frag, file)."""
+    rt = node.runtime
+    fh, f = next(((k[0], v) for k, v in
+                  rt.state.iter_prefix("file_bank", "file")
+                  if v.state == "active"))
+    frag = f.segments[0].fragment_hashes[row]
+    victim = next(m for m in miners if frag in m.store)
+    del victim.store[frag]
+    victim.tags.pop(frag, None)
+    node.submit_extrinsic(victim.account, "file_bank.generate_restoral_order",
+                          fh, frag)
+    return frag, f
+
+
+def test_repair_symbols_mode_cuts_ingress(storage_net):
+    """Regenerating repair: the rebuilder ingresses ONE fragment-sized
+    aggregate off the helper chain instead of k whole fragments, and
+    the result still re-hashes to the on-chain identity."""
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    rt = node.runtime
+    frag, f = _break_fragment(node, miners, row=1)
+    net.run_slots(1)
+    rescuer = next(m for m in miners if frag not in m.store)
+    rescuer.repair_mode = "symbols"
+    ingress0 = rescuer.repair_ingress_bytes
+    recovered0 = rescuer.repair_recovered_bytes
+    try:
+        assert rescuer.try_repair(frag, miners, [gw])
+    finally:
+        rescuer.repair_mode = "fragments"
+    assert fragment_hash(rescuer.store[frag]) == frag
+    # one aggregate in, k fragments' worth recovered-to-ingress ratio 1
+    assert rescuer.repair_ingress_bytes - ingress0 == cfg.fragment_size
+    assert rescuer.repair_recovered_bytes - recovered0 == cfg.fragment_size
+    assert rescuer.repair_symbol_repairs >= 1
+    assert rescuer.repair_fallbacks == 0
+    net.run_slots(1)
+    assert rt.file_bank.restoral_order(frag) is None
+    ev = rt.state.events_of("file_bank", "RestoralComplete")
+    assert dict(ev[-1].data)["miner"] == rescuer.account
+
+
+def test_repair_symbol_corruption_falls_back_to_fragments(storage_net):
+    """A corrupted symbol aggregate fails the rebuilder's hash check;
+    the repair falls back to whole-fragment fetch, stores only
+    verified bytes, and the fallback is counted + accounted."""
+    from cess_tpu.resilience import faults
+    from cess_tpu.resilience.faults import FaultPlan, FaultSpec
+
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    rt = node.runtime
+    frag, f = _break_fragment(node, miners, row=2)
+    net.run_slots(1)
+    rescuer = next(m for m in miners if frag not in m.store)
+    rescuer.repair_mode = "symbols"
+    ingress0 = rescuer.repair_ingress_bytes
+    fallbacks0 = rescuer.repair_fallbacks
+    whole0 = rescuer.repair_whole_repairs
+    plan = FaultPlan({"offchain.symbol_bytes": {0: FaultSpec("corrupt",
+                                                             xor=0x01)}})
+    try:
+        with faults.armed(plan):
+            assert rescuer.try_repair(frag, miners, [gw])
+    finally:
+        rescuer.repair_mode = "fragments"
+    assert fragment_hash(rescuer.store[frag]) == frag
+    assert rescuer.repair_fallbacks - fallbacks0 == 1
+    assert rescuer.repair_whole_repairs - whole0 == 1
+    # the corrupt aggregate (n) still counts as ingress, then the
+    # whole-fragment path pays k*n on top — honest accounting
+    assert rescuer.repair_ingress_bytes - ingress0 \
+        == (1 + cfg.k) * cfg.fragment_size
+    net.run_slots(1)
+    assert rt.file_bank.restoral_order(frag) is None
+
+
+def test_repair_rejects_corrupt_reconstruction(storage_net):
+    """Integrity regression: a decode fed bad survivor bytes must NOT
+    be stored or claimed — the reconstructed fragment re-hashes
+    against the on-chain identity first, on both dispatch modes."""
+    spec, net, node, gw, miners, tee, cfg = storage_net
+    rt = node.runtime
+    frag, f = _break_fragment(node, miners, row=1)
+    net.run_slots(1)
+    assert rt.file_bank.restoral_order(frag) is not None
+    rescuer = next(m for m in miners if frag not in m.store)
+    # poison the first-scanned survivor row (same key, wrong bytes)
+    other_row = next(j for j, h in enumerate(f.segments[0].fragment_hashes)
+                     if j != 1)
+    survivor_hash = f.segments[0].fragment_hashes[other_row]
+    holder = next(m for m in miners if survivor_hash in m.store)
+    good = holder.store[survivor_hash]
+    holder.store[survivor_hash] = bytes(len(good))
+    try:
+        for mode in ("fragments", "symbols"):
+            rescuer.repair_mode = mode
+            assert not rescuer.try_repair(frag, miners, [gw])
+            assert frag not in rescuer.store
+    finally:
+        rescuer.repair_mode = "fragments"
+        holder.store[survivor_hash] = good
+    # with honest survivors the same order then repairs cleanly
+    assert rescuer.try_repair(frag, miners, [gw])
+    assert fragment_hash(rescuer.store[frag]) == frag
+    net.run_slots(1)
+    assert rt.file_bank.restoral_order(frag) is None

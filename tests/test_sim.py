@@ -249,6 +249,36 @@ def _assert_scenario_behavior(name, report):
                  if m.startswith("repair_contend:")]
         assert marks and int(marks[0].split(":")[1]) >= 2, \
             "contention needs at least two racing reconstructions"
+    elif name == "repair_storm":
+        # ISSUE 15: the mass-failure storm really ran in symbol mode —
+        # a batch of miners died, their whole fragment custody flooded
+        # the market, the rescuers drained it regeneratively (fleet
+        # ingress strictly below the k-fragment baseline, zero
+        # fallbacks), and the seeded lane trip mid-storm left an
+        # incident bundle behind
+        miners = report.world.miners
+        ingress = sum(m.repair_ingress_bytes for m in miners)
+        recovered = sum(m.repair_recovered_bytes for m in miners)
+        assert recovered > 0, "the storm never recovered a byte"
+        assert ingress < report.world.storage.k * recovered, \
+            "repair ingress did not beat the whole-fragment baseline"
+        assert sum(m.repair_fallbacks for m in miners) == 0
+        assert sum(m.repair_symbol_repairs for m in miners) >= 2
+        marks = [m for _t, m in report.world.queue.fired_log()
+                 if m.startswith("storm_")]
+        kills = [m for m in marks if m.startswith("storm_kill:")]
+        assert len(kills) >= 2, "the storm must kill a BATCH of miners"
+        assert sum(int(m.rsplit(":", 1)[1]) for m in kills) >= 4, \
+            "the kills opened too few restoral orders for a storm"
+        assert "breaker-trip" in [b["trigger"]
+                                  for b in report.reporter.bundles()], \
+            "the mid-storm lane trip left no incident bundle"
+        done: dict = {}
+        for e in rt.state.events_of("file_bank", "RestoralComplete"):
+            d = dict(e.data)
+            done.setdefault(d["fragment_hash"], []).append(d["miner"])
+        assert done and all(len(v) == 1 for v in done.values()), \
+            "the market must pay exactly one winner per fragment"
     elif name == "miner_churn":
         # whether a 0.12-rate drop ordinal is actually crossed depends
         # on seed and world size; what matters for replay is that the

@@ -108,11 +108,13 @@ def newest_rounds() -> list[str]:
 
 
 def lower_is_better(metric: str) -> bool:
-    # latencies (_ms) and wall-clock drains (_s) regress UPWARD;
-    # rates (_per_s, _GiBps, _x) regress downward — "_s" must not
-    # swallow throughput names like podr2_..._frags_per_s
+    # latencies (_ms), wall-clock drains (_s) and repair-cost ratios
+    # (_per_recovered_byte) regress UPWARD; rates (_per_s, _GiBps, _x)
+    # regress downward — "_s" must not swallow throughput names like
+    # podr2_..._frags_per_s
     return metric.endswith("_ms") or (
-        metric.endswith("_s") and not metric.endswith("_per_s"))
+        metric.endswith("_s") and not metric.endswith("_per_s")) or \
+        metric.endswith("_per_recovered_byte")
 
 
 def diff(prev: dict[str, float], cur: dict[str, float],
