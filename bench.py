@@ -399,7 +399,7 @@ def bench_repair_storm(n_files: int, kill: int = 2, max_rounds: int = 30):
         n_lanes = eng.pool.n_devices
         for r in rescuers:
             r.attach_engine(eng)
-            r.repair_mode = "symbols"
+            r.set_repair_mode("symbols")
             r.warm_restoral()              # per-lane AOT warm: untimed
         ingress0 = sum(r.repair_ingress_bytes for r in rescuers)
         rec0 = sum(r.repair_recovered_bytes for r in rescuers)
@@ -976,11 +976,12 @@ def main() -> None:
     ap.add_argument("--metrics", default="all",
                     help="comma list: decode,speedup,repair,podr2,"
                          "pool,stream,degraded,traceov,adaptive,"
-                         "encode,sim,fleet,profile,chainwatch")
+                         "encode,sim,fleet,profile,chainwatch,"
+                         "remediate")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "pool", "stream",
              "degraded", "traceov", "adaptive", "encode", "sim",
-             "fleet", "profile", "chainwatch"}
+             "fleet", "profile", "chainwatch", "remediate"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -1271,6 +1272,93 @@ def main() -> None:
                     "(unprofiled - profiled)/unprofiled over "
                     "back-to-back runs — noise-level values (incl. "
                     "slightly negative) mean the seams are free")
+
+    if "remediate" in which:
+        # the control-loop pin (ISSUE 16), two numbers: (a) the
+        # remediation plane's edge->action latency in OBSERVATION
+        # ROUNDS — the plane is count-sequenced and never reads a
+        # clock, so its own tick is the only honest latency unit: a
+        # perf-regression edge is injected through the armed journal
+        # and we count ticks until the pin action has actually latched
+        # the codec monitor (then the recovery edge, ticks until
+        # release); (b) what an ARMED plane costs the hottest
+        # instrumented path. Both (b) runs carry the same armed
+        # FlightRecorder, so the delta isolates the plane's journal
+        # listener — retention's own cost is pinned separately by
+        # traceov's flight_overhead_frac.
+        from cess_tpu.obs import flight as obs_flight
+        from cess_tpu.resilience import ResilienceConfig
+        from cess_tpu.serve import make_engine
+        from cess_tpu.serve.remediate import RemediationPlane
+
+        eng = make_engine(4, 8, rs_backend="jax",
+                          resilience=ResilienceConfig())
+        recorder = obs_flight.FlightRecorder(b"bench-remediate")
+        plane = RemediationPlane(b"bench-remediate")
+        plane.bind_engine(eng)
+        recorder.add_listener(plane.on_note)
+        try:
+            with obs_flight.armed(recorder):
+                obs_flight.note("perf", "regression", metric="encode",
+                                frm="ok", to="regressed", window=0)
+                react = 0
+                while react < 8:
+                    react += 1
+                    plane.tick()
+                    if any(e["event"] == "fire" and e["applied"]
+                           for e in plane.journal()):
+                        break
+                assert eng.monitors["codec"].state == "held", \
+                    "remediation pin never latched the codec monitor"
+                obs_flight.note("perf", "regression", metric="encode",
+                                frm="regressed", to="ok", window=1)
+                release = 0
+                while release < 8:
+                    release += 1
+                    plane.tick()
+                    if any(e["event"] == "release"
+                           for e in plane.journal()):
+                        break
+                assert eng.monitors["codec"].state != "held", \
+                    "remediation never released the recovered pin"
+        finally:
+            eng.close()
+        emit("remediation_react_rounds", float(react), "rounds",
+             1.0 / react,
+             release_rounds=release,
+             journal_entries=plane.snapshot()["journal_total"],
+             method="count-sequenced edge->action latency: ticks from "
+                    "an injected perf-regression journal edge until "
+                    "the perf-pin policy's hold_open has latched the "
+                    "codec monitor (release_rounds: the recovery edge "
+                    "to release), measured in the plane's own "
+                    "observation rounds — never wall-clock")
+        rec_off = obs_flight.FlightRecorder(b"bench-remediate-off")
+        with obs_flight.armed(rec_off):
+            v_off, _ = bench_stream(jnp, jax, stream_batch, stream_n,
+                                    seg)
+        rec_on = obs_flight.FlightRecorder(b"bench-remediate-on")
+        plane2 = RemediationPlane(b"bench-remediate-on")
+        rec_on.add_listener(plane2.on_note)
+        with obs_flight.armed(rec_on):
+            v_on, _ = bench_stream(jnp, jax, stream_batch, stream_n,
+                                   seg)
+            plane2.tick()
+        frac = (v_off - v_on) / v_off
+        if _ASSERT_FINITE:
+            assert np.isfinite(frac), \
+                f"remediation_overhead_frac produced {frac!r}"
+        emit("stream_encode_tag_remediated_GiBps", v_on, "GiB/s",
+             v_on / 12.0,
+             unremediated_GiBps=round(v_off, 3),
+             remediation_overhead_frac=round(frac, 4),
+             edges=plane2.snapshot()["edges_total"],
+             method="streamed from-host-bytes run with a "
+                    "RemediationPlane listening on the armed flight "
+                    "recorder vs the same armed recorder without one; "
+                    "remediation_overhead_frac = (off - on)/off over "
+                    "back-to-back runs — noise-level values (incl. "
+                    "slightly negative) mean the listener is free")
 
     if "adaptive" in which:
         # sustained mixed encode+verify at a fixed verify p99 target,

@@ -53,6 +53,12 @@ class _SimRule(Rule):
         # bit-identical replays just like one inside sim/
         if "ops" in parts and parts[-1] == "regen.py":
             return True
+        # the remediation plane's action journal is part of the replay
+        # witness (same seed => byte-identical action log), so it is
+        # held to the sim contract: decisions advance on observation
+        # count only, never a clock read or an entropy draw
+        if "serve" in parts and parts[-1] == "remediate.py":
+            return True
         # the retention layer, the fleet plane, the profile plane and
         # the chain plane make seeded decisions under the same replay
         # contract as sim worlds

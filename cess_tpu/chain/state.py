@@ -70,6 +70,11 @@ class State:
         self._tx_marks: list[tuple[int, int]] = []   # (journal len, events len)
         self._root_acc: int = 0
         self._key_hash: dict[tuple, int] = {}        # key -> current entry hash
+        self._key_enc: dict[tuple, bytes] = {}       # key -> codec encoding
+        # (pallet, item) -> keys under that pair: iter_prefix/count_prefix
+        # are O(bucket), not O(total state) — the per-block pallet scans
+        # (lease GC, deal sweeps) are the hot callers
+        self._pfx: dict[tuple, set[tuple]] = {}
         # (pallet, name|None) -> [(block, event)]; lazily pruned to the
         # history floor (may briefly retain a superset of a partially
         # trimmed block — a query-index property, not consensus state)
@@ -77,9 +82,14 @@ class State:
         self._hist_floor: int = 0
 
     # -- root accounting -----------------------------------------------------
-    @staticmethod
-    def _entry_hash(key: tuple, value: Any) -> int:
-        data = codec.encode(key) + b"\x00" + codec.encode(value)
+    def _entry_hash(self, key: tuple, value: Any) -> int:
+        # keys are immutable tuples re-hashed on every put of the same
+        # slot (block context, base fee, ...) — cache their encoding;
+        # values change between puts and are encoded fresh
+        enc = self._key_enc.get(key)
+        if enc is None:
+            enc = self._key_enc[key] = codec.encode(key)
+        data = enc + b"\x00" + codec.encode(value)
         return int.from_bytes(hashlib.sha256(data).digest(), "little")
 
     def _root_add(self, key: tuple, value: Any) -> None:
@@ -91,6 +101,20 @@ class State:
         h = self._key_hash.pop(key, None)
         if h is not None:
             self._root_acc = (self._root_acc - h) % _ROOT_MOD
+
+    # -- prefix index --------------------------------------------------------
+    def _index_add(self, key: tuple) -> None:
+        self._pfx.setdefault(key[:2], set()).add(key)
+
+    def _index_del(self, key: tuple) -> None:
+        bucket = self._pfx.get(key[:2])
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:
+                del self._pfx[key[:2]]
+        # the key is gone: drop its cached encoding too, or pruned
+        # history keys (eth receipts, ...) grow the cache forever
+        self._key_enc.pop(key, None)
 
     # -- kv ----------------------------------------------------------------
     def get(self, *key, default=None):
@@ -110,6 +134,7 @@ class State:
         self._journal.append((key, self.kv.get(key, _TOMBSTONE)))
         self._root_sub(key)
         self._root_add(key, value)
+        self._index_add(key)
         self.kv[key] = value
 
     def delete(self, *key) -> None:
@@ -117,21 +142,32 @@ class State:
         if key in self.kv:
             self._journal.append((key, self.kv[key]))
             self._root_sub(key)
+            self._index_del(key)
             del self.kv[key]
+
+    def _prefix_keys(self, prefix: tuple) -> list[tuple]:
+        """Candidate keys for a prefix, via the (pallet, item) index."""
+        if len(prefix) >= 2:
+            # cesslint: disable=consensus-unordered-iter — callers sort
+            return list(self._pfx.get(prefix[:2], ()))
+        # 0- or 1-element prefix: walk the (small) bucket directory
+        # cesslint: disable=consensus-unordered-iter — callers sort
+        return [k for b, keys in self._pfx.items()
+                if not prefix or b[0] == prefix[0] for k in keys]
 
     def iter_prefix(self, *prefix) -> Iterator[tuple[tuple, Any]]:
         """Iterate (suffix, value) for all keys under a prefix, sorted
         (determinism: iteration order is part of consensus)."""
         n = len(prefix)
-        # cesslint: disable=consensus-unordered-iter — sorted below
-        items = [(k[n:], v) for k, v in self.kv.items()
+        items = [(k[n:], self.kv[k]) for k in self._prefix_keys(prefix)
                  if len(k) > n and k[:n] == prefix]
         items.sort(key=lambda kv: repr(kv[0]))
         return iter(items)
 
     def count_prefix(self, *prefix) -> int:
         n = len(prefix)
-        return sum(1 for k in self.kv if len(k) > n and k[:n] == prefix)
+        return sum(1 for k in self._prefix_keys(prefix)
+                   if len(k) > n and k[:n] == prefix)
 
     # -- events ------------------------------------------------------------
     def deposit_event(self, _pallet: str, _name: str, **data) -> None:
@@ -192,10 +228,12 @@ class State:
             key, old = self._journal.pop()
             self._root_sub(key)
             if old is _TOMBSTONE:
+                self._index_del(key)
                 self.kv.pop(key, None)
             else:
                 self.kv[key] = old
                 self._root_add(key, old)
+                self._index_add(key)
         del self.events[emark:]
 
     # -- block undo (fork-choice support) -----------------------------------
@@ -216,10 +254,12 @@ class State:
         for key, old in reversed(undo):
             self._root_sub(key)
             if old is _TOMBSTONE:
+                self._index_del(key)
                 self.kv.pop(key, None)
             else:
                 self.kv[key] = old
                 self._root_add(key, old)
+                self._index_add(key)
 
     # -- roots --------------------------------------------------------------
     def state_root(self) -> bytes:
@@ -241,6 +281,11 @@ class State:
         return acc.to_bytes(32, "little")
 
     def rebuild_root_cache(self) -> None:
-        """Rebuild the per-key hash cache + accumulator from kv (used
-        by the persistence layer after loading a snapshot)."""
+        """Rebuild the per-key hash cache + accumulator + prefix index
+        from kv (used by the persistence layer after swapping in a
+        snapshot's kv wholesale)."""
+        self._key_enc = {}
         self._root_acc, self._key_hash = self._fold_root()
+        self._pfx = {}
+        for k in self.kv:
+            self._index_add(k)

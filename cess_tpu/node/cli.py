@@ -166,6 +166,28 @@ def main(argv=None) -> int:
                          "gossip and peers fold per-node finality "
                          "lag into their quorum views. Absent = "
                          "zero-cost off (the --trace contract)")
+    ap.add_argument("--remediate", nargs="?", const="act",
+                    default=None, choices=["act", "dry"],
+                    help="arm the remediation plane "
+                         "(cess_tpu/serve/remediate.py) on this "
+                         "node: a count-sequenced policy engine that "
+                         "subscribes to the --flight recorder's "
+                         "detector edges (perf regressions, breaker "
+                         "trips, fleet stragglers, chain anomalies) "
+                         "and maps each through a declarative policy "
+                         "table to a journaled recovery action — pin "
+                         "a class to the reference backend, "
+                         "quarantine a pool lane, file an "
+                         "equivocation offence, flip a miner's "
+                         "repair mode — with count-based cooldowns, "
+                         "rate limits and release conditions. "
+                         "'--remediate=dry' journals every decision "
+                         "without acting. Served via the "
+                         "cess_remediationStatus RPC and "
+                         "cess_remediation_* gauges on GET /metrics "
+                         "(render with tools/remediation_view.py). "
+                         "Requires --flight; absent = zero-cost off "
+                         "(the --trace contract)")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="TARGETS",
                     help="attach an SLO board (cess_tpu/obs/slo.py) to "
@@ -384,6 +406,8 @@ def main(argv=None) -> int:
         nodes[0].incidents = reporter  # cess_incidentDump RPC surface
     plane = _arm_cli_fleet(args, nodes[0], reporter)
     watch = _arm_cli_chainwatch(args, nodes[0], reporter, plane)
+    remediation = _arm_cli_remediate(args, nodes[0], recorder,
+                                     reporter, engine)
     rpc = None
     import threading
 
@@ -418,6 +442,13 @@ def main(argv=None) -> int:
             if plane is not None and slot % 4 == 0:
                 with chain_lock:
                     plane.tick()
+            # the remediation plane decides AFTER the detectors'
+            # scan/tick above: edges they announced this slot land as
+            # actions in the same decision round. Actions may submit
+            # extrinsics, so the tick runs under the chain lock
+            if remediation is not None and slot % 4 == 0:
+                with chain_lock:
+                    remediation.tick()
             if args.block_time:
                 time.sleep(args.block_time)
     except KeyboardInterrupt:
@@ -428,6 +459,7 @@ def main(argv=None) -> int:
         if engine is not None:
             engine.close()
         _finish_cli_profile(engine)
+        _finish_cli_remediate(remediation)
         _finish_cli_chainwatch(watch)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
@@ -615,6 +647,52 @@ def _finish_cli_chainwatch(watch) -> None:
           f"evidence record(s), "
           f"{snap['anomalies']['anomalies']} anomaly edge(s); "
           f"{verdict}", file=sys.stderr)
+
+
+def _arm_cli_remediate(args, node, recorder, reporter, engine):
+    """--remediate: arm a RemediationPlane (serve/remediate.py) as
+    ``node.remediation``: it subscribes to the --flight recorder's
+    detector edges and acts through the node (extrinsics) and the
+    --engine (monitor pins, lane quarantine) when one exists. The
+    author/main loop ticks it every few slots — AFTER the detector
+    scans, so their edges are decided in the same round. With
+    ``--remediate=dry`` every decision is journaled but no seam is
+    touched. Returns the plane or None."""
+    if getattr(args, "remediate", None) is None:
+        return None
+    if recorder is None:
+        print("--remediate requires --flight (the policy engine "
+              "subscribes to the flight recorder's detector edges)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    from ..serve.remediate import RemediationPlane
+
+    plane = RemediationPlane(b"cess-cli",
+                             dry_run=args.remediate == "dry")
+    if engine is not None:
+        plane.bind_engine(engine)
+    plane.bind_node(node)
+    recorder.add_listener(plane.on_note)
+    if reporter is not None:
+        reporter.remediation = plane  # bundles embed the journal tail
+    node.remediation = plane
+    return plane
+
+
+def _finish_cli_remediate(plane) -> None:
+    """Print the remediation summary: decision counts and what is
+    still engaged (render the full cess_remediationStatus payload
+    with tools/remediation_view.py)."""
+    if plane is None:
+        return
+    snap = plane.snapshot()
+    c = snap["counters"]
+    engaged = ", ".join(sorted(snap["engaged"])) or "nothing engaged"
+    mode = " [dry-run]" if snap["dry_run"] else ""
+    print(f"remediation plane{mode}: {snap['edges_total']} edge(s), "
+          f"{sum(snap['fires'].values())} fire(s), "
+          f"{c['suppressed']} suppressed, {c['releases']} release(s), "
+          f"{c['flaps']} flap(s); {engaged}", file=sys.stderr)
 
 
 def _finish_cli_profile(engine) -> None:
@@ -824,6 +902,8 @@ def _run_tcp_node(args, spec) -> int:
         node.incidents = reporter     # cess_incidentDump RPC surface
     plane = _arm_cli_fleet(args, node, reporter)
     watch = _arm_cli_chainwatch(args, node, reporter, plane)
+    remediation = _arm_cli_remediate(args, node, recorder, reporter,
+                                     engine)
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
     rpc = None
@@ -845,6 +925,13 @@ def _run_tcp_node(args, spec) -> int:
                 print(f"#{head.number} author={head.author} "
                       f"finalized=#{fin} peers={len(svc._known_peers)}",
                       file=sys.stderr)
+            # one remediation decision round per monitor iteration:
+            # edges the service's detector scans announced since the
+            # last pass become actions here. Extrinsic-filing actions
+            # share the service lock with block import
+            if remediation is not None:
+                with svc.lock:
+                    remediation.tick()
             if args.blocks and head.number >= args.blocks:
                 break
     except KeyboardInterrupt:
@@ -856,6 +943,7 @@ def _run_tcp_node(args, spec) -> int:
         if engine is not None:
             engine.close()
         _finish_cli_profile(engine)
+        _finish_cli_remediate(remediation)
         _finish_cli_chainwatch(watch)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)

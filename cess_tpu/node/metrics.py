@@ -76,6 +76,12 @@ def collect(node) -> dict[str, float]:
     chainwatch = getattr(node, "chainwatch", None)
     if chainwatch is not None:
         m.update(chainwatch.metrics())
+    # remediation-plane gauges (serve/remediate.py): policy fires,
+    # suppressions, live engagements, flaps when a RemediationPlane is
+    # armed (node.cli --remediate)
+    remediation = getattr(node, "remediation", None)
+    if remediation is not None:
+        m.update(remediation.metrics())
     return m
 
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import threading
 import time
 
 import jax.numpy as jnp
@@ -207,8 +208,12 @@ class MinerAgent:
         # repair dispatch mode (ops/regen.py): "fragments" fetches k
         # whole survivor rows per repair; "symbols" walks the
         # product-matrix repair-symbol chain through the helpers so
-        # only the final fragment-sized aggregate is ingressed.
+        # only the final fragment-sized aggregate is ingressed. The
+        # mode can be flipped mid-run (set_repair_mode) by tests or
+        # the remediation plane; the lock keeps the flip + flight
+        # note atomic against concurrent flippers.
         self.repair_mode = "fragments"
+        self._mode_mu = threading.Lock()
         # ingress accounting: every repair is charged by the bytes
         # that crossed the wire INTO this miner vs the bytes it
         # recovered — the regenerating claim is ingress/recovered ~ 1
@@ -240,6 +245,22 @@ class MinerAgent:
                 f"miner pipeline RS({self.pipeline.config.k},"
                 f"{self.pipeline.config.m})")
         self.engine = engine
+
+    def set_repair_mode(self, mode: str) -> None:
+        """Flip the repair dispatch mode mid-run. Thread-safe and
+        flight-noted (("repair", "mode")) so mode changes show up in
+        incident bundles; a no-op flip stays silent."""
+        if mode not in ("symbols", "fragments"):
+            raise ValueError(
+                f"repair_mode must be 'symbols' or 'fragments', "
+                f"got {mode!r}")
+        with self._mode_mu:
+            frm = self.repair_mode
+            if frm == mode:
+                return
+            self.repair_mode = mode
+        _flight.note("repair", "mode", miner=self.account, frm=frm,
+                     to=mode)
 
     # -- fillers -----------------------------------------------------------------
     def setup_fillers(self, tee: "TeeAgent", count: int) -> None:

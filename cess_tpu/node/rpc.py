@@ -46,6 +46,11 @@ Methods:
                       market ledger and anomaly transitions;
                       obs/chainwatch.py, armed via node.cli
                       --chainwatch)
+  cess_remediationStatus
+                     (remediation plane: the policy table, live
+                      engagements, detector-health evidence and the
+                      action journal; serve/remediate.py, armed via
+                      node.cli --remediate)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -376,6 +381,13 @@ class RpcServer:
             # Null when the node runs without a chain watch
             # (node.cli --chainwatch).
             plane = getattr(node, "chainwatch", None)
+            return None if plane is None else plane.snapshot()
+        if method == "cess_remediationStatus":
+            # remediation plane (serve/remediate.py): the policy
+            # table, live engagements, detector-health evidence and
+            # the action journal. Null when the node runs without a
+            # remediation plane (node.cli --remediate).
+            plane = getattr(node, "remediation", None)
             return None if plane is None else plane.snapshot()
         if method == "cess_sloStatus":
             # SLO observability debug surface (obs/slo.py): per-class

@@ -29,6 +29,11 @@ trigger class       journal entry (subsystem, kind)
 ``equivocation``    (obs/chainwatch.py); the journal detail's ``cls``
 ``audit-failure-``  names the trigger class and the bundle embeds the
 ``spike``           chain-health snapshot
+``remediation-``    ``("remediation", "flap")`` — a remediation policy
+``flap``            fired, released, and re-fired inside its own
+                    cooldown window (serve/remediate.py): the control
+                    loop is oscillating, so it files its own
+                    postmortem instead of churning silently
 ==================  ========================================================
 
 Each bundle is self-contained: the pinned traces, the journal tail,
@@ -68,7 +73,7 @@ from .trace import _json_safe
 # witness)
 _CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
                         "finality", "flight", "fleet", "perf", "chain",
-                        "repair"))
+                        "repair", "remediation"))
 
 # the chain anomaly classes obs/chainwatch.py announces; the journal
 # detail's ``cls`` IS the trigger class (one note kind, four triggers)
@@ -120,6 +125,11 @@ class IncidentReporter:
                    gain a ``chain`` snapshot section (consensus views,
                    equivocation evidence, the market ledger), the
                    chain-anomaly postmortem's health truth source.
+    remediation:   optional serve/remediate.py RemediationPlane —
+                   bundles gain a ``remediation`` snapshot section
+                   (policy table, engagements, the action journal
+                   tail): what the autopilot was doing at trigger
+                   time.
     context:       optional callable returning a dict merged into each
                    bundle — sim runs supply the scenario seed +
                    witness needed to replay the episode.
@@ -134,7 +144,7 @@ class IncidentReporter:
 
     def __init__(self, recorder, *, engine=None, board=None, plan=None,
                  stitcher=None, profile=None, chainwatch=None,
-                 context=None,
+                 remediation=None, context=None,
                  max_per_class: int = 4,
                  max_bundles: int = 32, shed_storm: int = 8,
                  repair_degraded: int = 8,
@@ -151,6 +161,7 @@ class IncidentReporter:
         self.profile = profile if profile is not None \
             else getattr(engine, "profile", None)
         self.chainwatch = chainwatch
+        self.remediation = remediation
         self.context = context
         self.max_per_class = max_per_class
         self.shed_storm = shed_storm
@@ -229,6 +240,11 @@ class IncidentReporter:
                 return
             self.trigger("perf-regression",
                          key=str(detail.get("metric")), detail=detail)
+        elif subsystem == "remediation" and kind == "flap":
+            self.trigger("remediation-flap",
+                         key=f"{detail.get('policy')}:"
+                             f"{detail.get('key')}",
+                         detail=detail)
         elif subsystem == "chain" and kind == "anomaly":
             # edge-triggered both ways by the detector; only the
             # ok->bad edge is an incident, and the detail's cls must
@@ -306,6 +322,15 @@ class IncidentReporter:
             # chain-anomaly postmortem's consensus views, equivocation
             # evidence and market ledger at trigger time
             snapshots["chain"] = chainwatch.snapshot()
+        remediation = self.remediation
+        if remediation is not None:
+            # what the autopilot was doing at trigger time: the policy
+            # table, live engagements, and the action journal tail.
+            # The journal is count-sequenced and replay-stable, but it
+            # rides evidence-side here — the plane has its own witness
+            snap = remediation.snapshot()
+            snap["journal"] = snap["journal"][-self.journal_tail:]
+            snapshots["remediation"] = snap
         stitcher = self.stitcher
         stitched = [] if stitcher is None else stitcher.traces()
         with self._mu:

@@ -24,12 +24,20 @@ routes the batcher's drained batches across per-device worker lanes
 (deterministic least-loaded placement, per-(backend, device)
 breakers, drain-to-sibling on lane failure) — opt-in via
 ``make_engine(pool=...)`` / ``node.cli --pool[=N]``.
+
+remediate.py closes the control loop (ISSUE 16): a count-sequenced
+RemediationPlane subscribes to the flight recorder's detector edges
+and maps each through a declarative Policy table to a journaled,
+replayable recovery action (pin-to-reference, lane quarantine,
+on-chain offence filing, repair-mode flip) — opt-in via
+``node.cli --remediate`` / ``Scenario.remediate=True``.
 """
 from .adaptive import AdaptiveBatchPolicy, AdmissionController
 from .engine import EngineFuture, SubmissionEngine, make_engine
 from .policy import (AdmissionPolicy, EngineClosed, EngineError,
                      EngineSaturated, EngineShed, EngineTimeout)
 from .pool import DevicePool
+from .remediate import Policy, RemediationPlane, default_policies
 from .stats import EngineStats, StreamStats
 from .stream import StreamingIngest
 
@@ -45,8 +53,11 @@ __all__ = [
     "EngineShed",
     "EngineStats",
     "EngineTimeout",
+    "Policy",
+    "RemediationPlane",
     "StreamStats",
     "StreamingIngest",
     "SubmissionEngine",
+    "default_policies",
     "make_engine",
 ]

@@ -27,6 +27,13 @@ must be re-derivable from the per-node states it ingested — worst-of
 and quorum recomputed from scratch must match the FleetBoard,
 federated counters must be nonnegative, and no stitched span may
 reference a parent uid outside its trace).
+
+The ``remediation`` family (ISSUE 16) judges the control loop itself:
+``remediation-coverage`` (every detector edge the policy table
+matched has a journaled fire/suppress decision by an enabled policy)
+and ``remediation-effective`` (every engagement is visibly latched on
+its seam, and a still-regressed perf metric is never left without an
+active or cooldown-fresh engagement).
 """
 from __future__ import annotations
 
@@ -328,6 +335,97 @@ def check_fleet_consistency(world) -> list[str]:
     return out
 
 
+def check_remediation_coverage(world) -> list[str]:
+    """ISSUE 16: every detector edge the remediation policy table
+    matched (trigger + guard) must have a journaled DECISION — a fire
+    or an explicit suppression — by an ENABLED policy. An edge that
+    matched a disabled row, or matched and was silently dropped, is
+    the autopilot sleeping through its alarm."""
+    plane = getattr(world, "remediation", None)
+    if plane is None:
+        return []
+    out = []
+    pols = {p.name: p for p in plane.policies()}
+    decided = {e["edge"] for e in plane.journal()
+               if e["event"] in ("fire", "suppress")}
+    count = plane.count
+    for edge in plane.edge_log():
+        if edge["tick"] >= count:
+            continue          # arrived after the round's decision tick
+        p = pols.get(edge["policy"])
+        if p is not None and not p.enabled:
+            out.append(
+                f"remediation-coverage: edge #{edge['id']} "
+                f"({edge['policy']}:{edge['key']}) matched a DISABLED "
+                f"policy — no decision will ever be journaled")
+        elif edge["id"] not in decided:
+            out.append(
+                f"remediation-coverage: edge #{edge['id']} "
+                f"({edge['policy']}:{edge['key']}) has no journaled "
+                f"fire/suppress decision")
+    return out
+
+
+def check_remediation_effective(world) -> list[str]:
+    """ISSUE 16: a fired policy must MEASURABLY hold — every live pin
+    engagement is visibly latched on its monitor (``state ==
+    "held"``), every live repair-mode engagement shows on the miner,
+    and a perf metric the detectors still grade ``regressed`` has an
+    active (or cooldown-fresh) engagement covering it. Fires when the
+    world was tampered behind the plane's back (someone released its
+    hold) — and on a world where the responsible policy is disabled,
+    because nothing ever engaged."""
+    plane = getattr(world, "remediation", None)
+    if plane is None:
+        return []
+    snap = plane.snapshot()
+    out = []
+    if not snap["dry_run"]:
+        for ekey, e in sorted(snap["engaged"].items()):
+            pname, _, key = ekey.partition(":")
+            if e["action"] in ("pin-reference", "quarantine-lane"):
+                mons = plane._pin_monitors(key) \
+                    if e["action"] == "pin-reference" \
+                    else plane._lane_monitors(key)
+                for mon in mons:
+                    if mon.state != "held":
+                        out.append(
+                            f"remediation-effective: {ekey} is "
+                            f"engaged but monitor "
+                            f"{getattr(mon, 'name', '?')} is "
+                            f"{mon.state!r}, not held")
+            elif e["action"] == "flip-repair-mode":
+                miner = plane._miners.get(key)
+                if miner is not None \
+                        and miner.repair_mode != "fragments":
+                    out.append(
+                        f"remediation-effective: {ekey} is engaged "
+                        f"but miner {key} still runs "
+                        f"{miner.repair_mode!r}")
+    perf_pols = [p for p in plane.policies()
+                 if tuple(p.trigger) == ("perf", "regression")]
+    for metric, state in sorted(snap["health"]["perf"].items()):
+        if state != "regressed" or not perf_pols:
+            continue
+        covered = False
+        for p in perf_pols:
+            ekey = f"{p.name}:{metric}"
+            if ekey in snap["engaged"]:
+                covered = True
+                break
+            if any(e["policy"] == p.name and e["key"] == metric
+                   and snap["count"] - e["tick"] <= max(p.cooldown, 1)
+                   for e in snap["journal"]):
+                covered = True          # cooldown-fresh decision
+                break
+        if not covered:
+            out.append(
+                f"remediation-effective: perf metric {metric} is "
+                f"still regressed with no active or recent "
+                f"remediation engagement")
+    return out
+
+
 CHECKERS = {
     "finalized-prefix": check_finalized_prefix,
     "vote-locks": check_vote_locks,
@@ -339,6 +437,8 @@ CHECKERS = {
     "repair-ingress-bound": check_repair_ingress_bound,
     "repair-drained": check_repair_drained,
     "fleet-consistency": check_fleet_consistency,
+    "remediation-coverage": check_remediation_coverage,
+    "remediation-effective": check_remediation_effective,
 }
 
 

@@ -85,6 +85,15 @@ class Scenario:
       plane (SLO class via :func:`_fleet_scrape`, straggler samples
       at seal), and the watch's witness joins
       :meth:`SimReport.witness` as the sixth stream.
+    - ``remediate``: arm a
+      :class:`~cess_tpu.serve.remediate.RemediationPlane` as
+      ``world.remediation``: it listens on the run's flight recorder,
+      binds the ``pool`` engine / miners / lowest node as action
+      seams, and ticks once per virtual round AFTER the scrapes (so
+      the round's detector edges are decided in the same round and
+      the ``remediation-*`` checkers see post-decision state). Its
+      action-journal witness joins :meth:`SimReport.witness` as the
+      seventh stream.
     """
 
     name: str
@@ -101,6 +110,7 @@ class Scenario:
     fleet: bool = False
     profile: bool = False
     chainwatch: bool = False
+    remediate: bool = False
     # with ``pool``: build the engine on the regenerating codec
     # (ops/regen.py, rs_backend="regen") so storm_repair rescuers run
     # symbol-mode repairs and the fold programs ride the lane caches
@@ -186,6 +196,11 @@ class SimReport:
     # + equivocation evidence + market ledger + anomaly transition
     # log) IS part of the replay contract, as the sixth witness stream
     chainwatch: "object | None" = None
+    # the remediation plane (ISSUE 16): the run's RemediationPlane
+    # when the scenario ran ``remediate=True`` — its action-journal
+    # witness (same seed => byte-identical action log) IS part of the
+    # replay contract, as the seventh witness stream
+    remediation: "object | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -196,7 +211,9 @@ class SimReport:
                 self.plan.fired_log() if self.plan is not None else (),
                 self.fleet.witness() if self.fleet is not None else b"",
                 self.chainwatch.witness()
-                if self.chainwatch is not None else b"")
+                if self.chainwatch is not None else b"",
+                self.remediation.witness()
+                if self.remediation is not None else b"")
 
 
 def _build_world(scenario: Scenario, seed, n_nodes: int | None) -> World:
@@ -320,7 +337,7 @@ def _apply_action(world: World, pending: dict, rnd: int,
             if eng is not None and rescuer.engine is None:
                 rescuer.attach_engine(eng)
                 if hasattr(eng.codec, "fold_symbol"):
-                    rescuer.repair_mode = "symbols"
+                    rescuer.set_repair_mode("symbols")
                 rescuer.warm_restoral()
             rt = rescuer.node.runtime
             for (frag,), order in sorted(
@@ -348,6 +365,18 @@ def _apply_action(world: World, pending: dict, rnd: int,
         world.queue.mark(f"repair_contend:{repaired}")
     elif action == "equivocate":
         _equivocate(world, args[0])
+    elif action == "perf_edge":
+        # scripted perf-watchdog edge: the live PerfWatchdog grades
+        # HOST timings against a bench anchor, so a real edge inside a
+        # sim world would be nondeterministic — the scenario scripts
+        # the transition itself through the same journal note the
+        # watchdog emits (obs/profile.py), and everything downstream
+        # (incident trigger, remediation policy) reacts identically
+        metric, to = args
+        _flight.note("perf", "regression", metric=metric,
+                     frm="regressed" if to == "ok" else "ok",
+                     to=to, window=rnd)
+        world.queue.mark(f"perf_edge:{metric}:{to}")
     else:
         raise ValueError(f"unknown scenario action {action!r}")
 
@@ -530,6 +559,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     reporter = None
     fleet_plane = None
     chain_watch = None
+    remediation = None
     stack = contextlib.ExitStack()
     try:
         with stack:
@@ -587,6 +617,22 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 if fleet_plane is not None:
                     chain_watch.attach_fleet(fleet_plane)
                 world.chainwatch = chain_watch
+            if scenario.remediate:
+                # the remediation plane (serve/remediate.py): armed as
+                # world.remediation, fed by the run's flight recorder,
+                # acting through whatever seams the scenario built —
+                # the pool engine's breakers, the storage miners, the
+                # lowest node's extrinsic surface
+                from ..serve.remediate import RemediationPlane
+
+                remediation = RemediationPlane(seed_b)
+                if scenario.pool:
+                    remediation.bind_engine(world.pipeline.engine)
+                remediation.bind_miners(
+                    getattr(world, "miners", ()) or ())
+                remediation.bind_node(world.nodes[0])
+                recorder.add_listener(remediation.on_note)
+                world.remediation = remediation
             # each bundle embeds the scenario identity + the live
             # witness streams — everything a replay needs
             reporter = IncidentReporter(
@@ -595,6 +641,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 else fleet_plane.stitcher,
                 profile=profile_plane,
                 chainwatch=chain_watch,
+                remediation=remediation,
                 context=lambda: {
                     "scenario": scenario.name,
                     "seed": seed_b.hex(),
@@ -627,6 +674,11 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                         _chainwatch_scrape(world, chain_watch, rnd)
                     if fleet_plane is not None:
                         _fleet_scrape(world, fleet_plane, rnd)
+                    if remediation is not None:
+                        # decide + apply the round's detector edges
+                        # BEFORE the checks: the remediation-*
+                        # invariants judge post-decision state
+                        remediation.tick()
                     run_checks(world, scenario.checks,
                                context=f"{scenario.name}:round{rnd}",
                                strict=strict)
@@ -652,7 +704,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                      uploads_active=active, recorder=recorder,
                      reporter=reporter, pool=pool_snap or None,
                      fleet=fleet_plane, profile=profile_snap or None,
-                     chainwatch=chain_watch)
+                     chainwatch=chain_watch, remediation=remediation)
 
 
 # -- the library --------------------------------------------------------------
@@ -818,6 +870,33 @@ SCENARIOS: dict[str, Scenario] = {
         final_checks=("restoral-single-winner", "repair-exactly-once",
                       "repair-ingress-bound", "repair-drained",
                       "storage-convergence"),
+    ),
+    # the autopilot drill (ISSUE 16): a scripted perf-watchdog edge
+    # degrades the encode class mid-run — the remediation plane's
+    # perf-pin policy latches the codec breaker held (the class now
+    # runs the reference backend) within one observation round, the
+    # recovery edge releases it, and a second regression later in the
+    # run fires again so its incident bundle embeds a non-empty
+    # action-journal tail. The remediation-* invariants run every
+    # round: each matched edge must have a journaled decision, each
+    # engagement must be visibly latched on the live monitor, and the
+    # plane's action-journal witness joins the replay contract as the
+    # seventh stream (bit-identical across same-seed runs at any n)
+    "perf_regression_autopilot": Scenario(
+        name="perf_regression_autopilot", rounds=14, pool=True,
+        remediate=True,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 4),))),
+        timeline=(
+            (1, "upload", 0, "alice", 20_000),
+            (3, "perf_edge", "encode", "regressed"),
+            (7, "perf_edge", "encode", "ok"),
+            (9, "perf_edge", "decode", "regressed"),
+            (11, "perf_edge", "decode", "ok"),
+        ),
+        checks=("finalized-prefix", "vote-locks",
+                "remediation-coverage", "remediation-effective"),
+        final_checks=("storage-convergence",),
     ),
     # a miner loses a fragment; TWO non-assigned rescuers race the
     # restoral order — both reconstruct, the market pays exactly one

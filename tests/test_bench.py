@@ -34,6 +34,8 @@ EXPECTED = (
     "chainwatch_100node_scan_ms",
     "repair_storm_drain_s",
     "ingress_bytes_per_recovered_byte",
+    "remediation_react_rounds",
+    "stream_encode_tag_remediated_GiBps",
 )
 
 
@@ -155,6 +157,18 @@ def test_bench_smoke_every_metric_finite():
     assert ing["baseline_bytes_per_byte"] == 2.0
     assert ing["value"] < ing["baseline_bytes_per_byte"]
     assert ing["ingress_bytes"] < 2 * ing["recovered_bytes"]
+    # the remediation pins (ISSUE 16): edge->action latency is
+    # count-sequenced — measured in the plane's own observation rounds,
+    # never wall-clock — and the armed-plane cost on the streamed path
+    # rides along as a finite overhead fraction (noise-level values,
+    # including slightly negative, mean the listener is free)
+    react = got["remediation_react_rounds"]
+    assert react["value"] >= 1 and react["release_rounds"] >= 1
+    assert react["journal_entries"] >= 2     # a fire AND a release
+    rem = got["stream_encode_tag_remediated_GiBps"]
+    assert math.isfinite(rem["remediation_overhead_frac"])
+    assert math.isfinite(rem["unremediated_GiBps"]) \
+        and rem["unremediated_GiBps"] > 0
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:

@@ -320,7 +320,9 @@ class TestDisarmedIsFree:
         report = run_scenario(sc, b"cold", n_nodes=8)
         assert report.chainwatch is None
         w = report.witness()
-        assert len(w) == 6 and w[5] == b""
+        # 7-tuple since the remediation plane joined the witness; both
+        # optional planes are empty-bytes when unarmed
+        assert len(w) == 7 and w[5] == b"" and w[6] == b""
 
 
 # -- the replay drill --------------------------------------------------------

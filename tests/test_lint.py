@@ -733,6 +733,35 @@ class TestSimDeterminism:
         assert r.suppressed == []
         assert analysis.load_baseline(BASELINE) == {}
 
+    def test_remediate_module_scans_clean_under_every_family(self):
+        """ISSUE 16 satellite: the shipped serve/remediate.py passes
+        trace-safety, lock-discipline, span-balance AND the sim
+        determinism family with zero suppressions — the plane's
+        count-sequenced journal is under the same replay contract as
+        the retention layer, so the wallclock/entropy bans apply on
+        top of the usual serve/ families. The dirty twins prove each
+        family really fires at that exact path, the clean sim twin
+        stays silent there, and the baseline stays empty."""
+        for dirty, rule in ((DIRTY_TRACE, "trace-print"),
+                            (DIRTY_LOCK, "lock-unguarded-write"),
+                            (DIRTY_SPAN, "span-balance"),
+                            (DIRTY_SIM, "sim-wallclock")):
+            assert rule in rules_at(
+                lint(dirty, "cess_tpu/serve/remediate.py")), rule
+        assert lint(CLEAN_SIM,
+                    "cess_tpu/serve/remediate.py").findings == []
+        # the borrow is scoped to remediate.py: its serve/ siblings do
+        # NOT inherit the determinism family
+        assert lint(DIRTY_SIM,
+                    "cess_tpu/serve/fixture.py").findings == []
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "serve", "remediate.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_chainwatch_module_scans_clean_under_every_family(self):
         """ISSUE 14 satellite: the shipped obs/chainwatch.py passes
         trace-safety, lock-discipline, span-balance AND the sim

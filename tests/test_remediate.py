@@ -1,0 +1,497 @@
+"""ISSUE 16 acceptance drill: the remediation plane turns detector
+edges into journaled, replayable recovery actions.
+
+The drill: in a seeded tampered world (a) a perf regression is
+auto-pinned to the reference backend and auto-released on recovery /
+re-probed on count; (b) an injected vote equivocation is auto-filed
+via ``offences.report_equivocation`` with the offender slashed and
+chilled on-chain; (c) a repair-ingress regression flips the miner's
+``repair_mode``. Two same-seed runs produce byte-identical
+``witness()`` action logs, a ``dry_run`` replay journals identically
+while applying nothing, and both ``remediation`` invariants provably
+fire on a world whose responsible policy is disabled.
+"""
+import dataclasses
+import threading
+import types
+
+import pytest
+
+from cess_tpu import constants
+from cess_tpu.chain.offences import sign_vote
+from cess_tpu.node.chain_spec import ChainSpec, ValidatorGenesis
+from cess_tpu.node.network import Network, Node
+from cess_tpu.node.offchain import MinerAgent
+from cess_tpu.obs import flight
+from cess_tpu.resilience import ResilienceConfig
+from cess_tpu.serve import make_engine
+from cess_tpu.serve.remediate import (ACTIONS, Policy, RemediationPlane,
+                                      default_policies)
+from cess_tpu.sim import (SCENARIOS, InvariantViolation, run_checks,
+                          run_scenario)
+
+D = constants.DOLLARS
+
+
+def note(plane, seq, sys, kind, **detail):
+    plane.on_note(seq, sys, kind, detail)
+
+
+@pytest.fixture()
+def engine():
+    eng = make_engine(4, 8, rs_backend="jax",
+                      resilience=ResilienceConfig())
+    yield eng
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# the policy table
+# ---------------------------------------------------------------------------
+class TestPolicyTable:
+    def test_unknown_action_and_bad_bounds_are_loud(self):
+        with pytest.raises(ValueError, match="unknown action"):
+            Policy(name="x", trigger=("a", "b"), action="reboot-it")
+        with pytest.raises(ValueError, match="max_fires"):
+            Policy(name="x", trigger=("a", "b"),
+                   action="pin-reference", max_fires=0)
+        with pytest.raises(ValueError, match="cooldown"):
+            Policy(name="x", trigger=("a", "b"),
+                   action="pin-reference", cooldown=-1)
+
+    def test_duplicate_policy_names_are_rejected(self):
+        p = default_policies()
+        with pytest.raises(ValueError, match="duplicate"):
+            RemediationPlane(b"x", p + (p[0],))
+
+    def test_default_table_covers_every_detector_altitude(self):
+        pols = {p.name: p for p in default_policies()}
+        assert set(pols) == {"perf-pin", "breaker-pin",
+                             "straggler-quarantine",
+                             "equivocation-report", "repair-ingress"}
+        # every shipped action verb is exercised by some default row
+        assert {p.action for p in pols.values()} == set(ACTIONS)
+        # rows are JSON-shaped for the RPC snapshot
+        row = pols["perf-pin"].row()
+        assert row["trigger"] == ["perf", "regression"]
+        assert row["match"] == [["to", "regressed"]]
+        assert row["release_match"] == [["to", "ok"]]
+
+
+# ---------------------------------------------------------------------------
+# drill (a): perf regression -> pin-reference -> release / re-probe
+# ---------------------------------------------------------------------------
+class TestPerfPinDrill:
+    def test_regression_pins_and_recovery_releases(self, engine):
+        plane = RemediationPlane(b"drill-pin")
+        plane.bind_engine(engine)
+        assert engine.monitors["codec"].state != "held"
+        note(plane, 1, "perf", "regression", metric="encode",
+             frm="ok", to="regressed", window=2)
+        plane.tick()
+        # the pin latched the class's reference-backend monitor
+        assert engine.monitors["codec"].state == "held"
+        fire = plane.journal()[-1]
+        assert fire["event"] == "fire" and fire["applied"] is True
+        assert fire["policy"] == "perf-pin" and fire["key"] == "encode"
+        assert "perf-pin:encode" in plane.engagements()
+        # the recovery edge releases the hold
+        note(plane, 2, "perf", "regression", metric="encode",
+             frm="regressed", to="ok", window=3)
+        plane.tick()
+        assert engine.monitors["codec"].state != "held"
+        rel = plane.journal()[-1]
+        assert rel["event"] == "release" and rel["reason"] == "recovered"
+        assert plane.engagements() == {}
+
+    def test_cooldown_suppression_then_flap_then_reprobe(self, engine):
+        plane = RemediationPlane(b"drill-flap")
+        plane.bind_engine(engine)
+        flaps = []
+        note(plane, 1, "perf", "regression", metric="encode",
+             frm="ok", to="regressed", window=1)
+        plane.tick()                                   # fire @ tick 1
+        note(plane, 2, "perf", "regression", metric="encode",
+             frm="regressed", to="ok", window=2)
+        plane.tick()                                   # release @ tick 2
+        # a refire inside the per-key cooldown window is suppressed
+        note(plane, 3, "perf", "regression", metric="encode",
+             frm="ok", to="regressed", window=3)
+        plane.tick()                                   # tick 3
+        sup = plane.journal()[-1]
+        assert sup["event"] == "suppress" and sup["reason"] == "cooldown"
+        assert engine.monitors["codec"].state != "held"
+        # past the fire cooldown but within cooldown of the RELEASE:
+        # the refire succeeds and is journaled as a flap, and the flap
+        # flight note feeds the incident plane's remediation-flap
+        # trigger
+        rec = flight.FlightRecorder(b"flap-notes")
+        rec.add_listener(lambda q, s, k, d: flaps.append((s, k, dict(d)))
+                         if s == "remediation" else None)
+        plane.tick()                                   # tick 4
+        plane.tick()                                   # tick 5
+        note(plane, 4, "perf", "regression", metric="encode",
+             frm="ok", to="regressed", window=6)
+        with flight.armed(rec):
+            plane.tick()                               # tick 6: fire+flap
+        events = [e["event"] for e in plane.journal()]
+        assert events[-2:] == ["fire", "flap"]
+        assert plane.journal()[-1]["reason"] == "refire-inside-cooldown"
+        assert ("remediation", "flap",
+                {"policy": "perf-pin", "action": "pin-reference",
+                 "key": "encode", "gap": 4}) in flaps
+        # no recovery edge: the count-based re-probe releases the
+        # engagement release_after ticks after the fire
+        for _ in range(8):
+            plane.tick()
+        rel = plane.journal()[-1]
+        assert rel["event"] == "release" and rel["reason"] == "re-probe"
+        assert engine.monitors["codec"].state != "held"
+
+    def test_breaker_trip_latches_the_named_monitor(self, engine):
+        plane = RemediationPlane(b"drill-breaker")
+        plane.bind_engine(engine)
+        note(plane, 1, "breaker", "trip", name="codec", window=5)
+        plane.tick()
+        assert engine.monitors["codec"].state == "held"
+        assert plane.journal()[-1]["policy"] == "breaker-pin"
+
+    def test_quarantine_holds_every_breaker_on_the_lane(self):
+        eng = make_engine(4, 8, rs_backend="jax",
+                          resilience=ResilienceConfig(), pool=2)
+        try:
+            plane = RemediationPlane(b"drill-lane")
+            plane.bind_engine(eng)
+            note(plane, 1, "fleet", "outlier", instance="bench.d1",
+                 metric="encode_p99_ms")
+            plane.tick()
+            lane = next(l for l in eng.pool.lanes if l.index == 1)
+            other = next(l for l in eng.pool.lanes if l.index == 0)
+            assert all(m.state == "held"
+                       for m in lane.monitors.values())
+            assert all(m.state != "held"
+                       for m in other.monitors.values())
+            # a key naming a foreign host resolves to nothing: the
+            # intent is journaled, honestly marked not-applied
+            note(plane, 2, "fleet", "outlier", instance="otherhost",
+                 metric="encode_p99_ms")
+            plane.tick()
+            ent = plane.journal()[-1]
+            assert ent["key"] == "otherhost" and not ent["applied"]
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# drill (b): injected equivocation -> offences.report_equivocation
+# ---------------------------------------------------------------------------
+def make_chain(n=3, chain_id="remediate-equiv"):
+    spec = ChainSpec(
+        name="t", chain_id=chain_id,
+        endowed=(("alice", 1_000_000_000 * D),),
+        validators=tuple(ValidatorGenesis(f"v{i}", 4_000_000 * D)
+                         for i in range(n)),
+        era_blocks=1000, epoch_blocks=1000, sudo="alice")
+    nodes = [Node(spec, f"node{i}",
+                  {f"v{i}": spec.session_key(f"v{i}")})
+             for i in range(n)]
+    return spec, nodes
+
+
+class TestEquivocationDrill:
+    def test_injected_equivocation_is_filed_slashed_and_chilled(self):
+        spec, nodes = make_chain()
+        net = Network(nodes)
+        net.run_slots(2)
+        node, evil = nodes[0], "v2"
+        key = spec.session_key(evil)
+        g = node.runtime.genesis_hash()
+        rnd = node.chain[-1].number + 50
+        node.finality.on_vote(
+            sign_vote(key, g, evil, rnd, b"\xaa" * 32, rnd))
+        node.finality.on_vote(
+            sign_vote(key, g, evil, rnd, b"\xbb" * 32, rnd))
+        assert node.finality.equivocations
+        bond0 = node.runtime.staking.bonded(evil)
+
+        # a dry-run plane journals the decision but files NOTHING
+        dry = RemediationPlane(b"drill-equiv", dry_run=True,
+                               reporter="alice")
+        dry.bind_node(node)
+        note(dry, 1, "chain", "anomaly", cls="equivocation",
+             key=f"{evil}@{rnd}", to="active")
+        dry.tick()
+        ent = dry.journal()[-1]
+        assert ent["event"] == "fire" and ent["applied"] is False
+        net.run_slots(1)
+        assert node.runtime.staking.bonded(evil) == bond0
+
+        # the acting plane matches the anomaly key against the node's
+        # own signed vote evidence and submits the extrinsic
+        plane = RemediationPlane(b"drill-equiv", reporter="alice")
+        plane.bind_node(node)
+        note(plane, 1, "chain", "anomaly", cls="equivocation",
+             key=f"{evil}@{rnd}", to="active")
+        plane.tick()
+        ent = plane.journal()[-1]
+        assert ent["event"] == "fire" and ent["applied"] is True
+        assert ent["action"] == "file-offence"
+        # one-shot: nothing stays engaged, nothing to release
+        assert plane.engagements() == {}
+        net.run_slots(1)
+        for n_ in nodes:
+            assert n_.runtime.staking.bonded(evil) == bond0 * 9 // 10
+            assert evil not in n_.runtime.staking.validators()
+            ev = n_.runtime.state.events_of("offences",
+                                            "EquivocationReported")
+            assert dict(ev[-1].data)["offender"] == evil
+        # a duplicate anomaly edge is suppressed by the huge per-key
+        # cooldown (the on-chain AlreadyReported dedup is the backstop)
+        note(plane, 2, "chain", "anomaly", cls="equivocation",
+             key=f"{evil}@{rnd}", to="active")
+        plane.tick()
+        sup = plane.journal()[-1]
+        assert sup["event"] == "suppress" and sup["reason"] == "cooldown"
+
+    def test_anomaly_without_local_evidence_is_not_applied(self):
+        spec, nodes = make_chain(chain_id="remediate-noev")
+        Network(nodes).run_slots(1)
+        plane = RemediationPlane(b"drill-noev", reporter="alice")
+        plane.bind_node(nodes[0])
+        note(plane, 1, "chain", "anomaly", cls="equivocation",
+             key="v1@99", to="active")
+        plane.tick()
+        ent = plane.journal()[-1]
+        # the intent is journaled; the seam honestly reports no-op
+        assert ent["event"] == "fire" and ent["applied"] is False
+
+
+# ---------------------------------------------------------------------------
+# drill (c): repair-ingress regression -> flip-repair-mode
+# ---------------------------------------------------------------------------
+class StubMiner:
+    """The MinerAgent surface the plane touches, nothing else."""
+
+    def __init__(self, account):
+        self.account = account
+        self.repair_mode = "symbols"
+        self.repair_ingress_bytes = 0
+        self.repair_recovered_bytes = 0
+        self.modes = []
+
+    def set_repair_mode(self, mode):
+        self.repair_mode = mode
+        self.modes.append(mode)
+
+
+class TestRepairModeDrill:
+    def test_ingress_regression_flips_and_reprobe_flips_back(self):
+        plane = RemediationPlane(b"drill-ingress")
+        m = StubMiner("m1")
+        plane.bind_miners([m])
+        # 4 ingressed bytes per recovered byte: past the 1.5x bound
+        m.repair_ingress_bytes = 4000
+        m.repair_recovered_bytes = 1000
+        plane.tick()
+        ent = plane.journal()[-1]
+        assert ent["policy"] == "repair-ingress"
+        assert ent["event"] == "fire" and ent["applied"] is True
+        assert ent["detail"]["ratio"] == 4.0
+        assert m.repair_mode == "fragments"
+        assert plane.intended_mode("m1") == "fragments"
+        # while engaged the sampler stays quiet (mode gate), and the
+        # count-based re-probe flips the miner back to symbols
+        for _ in range(12):
+            plane.tick()
+        assert plane.journal()[-1]["event"] == "release"
+        assert m.repair_mode == "symbols"
+        assert m.modes == ["fragments", "symbols"]
+
+    def test_healthy_ratio_never_fires(self):
+        plane = RemediationPlane(b"drill-healthy")
+        m = StubMiner("m1")
+        plane.bind_miners([m])
+        m.repair_ingress_bytes = 1100
+        m.repair_recovered_bytes = 1000
+        plane.tick()
+        assert plane.journal() == [] and m.repair_mode == "symbols"
+
+    def test_real_miner_set_repair_mode_is_threadsafe_and_noted(self):
+        m = MinerAgent(None, "m9", [], None)
+        with pytest.raises(ValueError, match="repair_mode"):
+            m.set_repair_mode("bogus")
+        seen = []
+        rec = flight.FlightRecorder(b"mode-notes")
+        rec.add_listener(
+            lambda q, s, k, d: seen.append((s, k, dict(d))))
+        with flight.armed(rec):
+            m.set_repair_mode("symbols")
+            m.set_repair_mode("symbols")     # no-op flip stays silent
+        assert seen == [("repair", "mode",
+                         {"miner": "m9", "frm": "fragments",
+                          "to": "symbols"})]
+        # concurrent flippers never tear the mode
+        def flip(mode):
+            for _ in range(200):
+                m.set_repair_mode(mode)
+        threads = [threading.Thread(target=flip, args=(mode,))
+                   for mode in ("symbols", "fragments") * 4]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert m.repair_mode in ("symbols", "fragments")
+
+
+# ---------------------------------------------------------------------------
+# the replay contract: same seed => byte-identical witness, dry or not
+# ---------------------------------------------------------------------------
+def _drive(dry_run):
+    """One scripted tampered-world episode: a perf regression, an
+    ingress regression, a recovery edge, quiet re-probe rounds, then
+    an equivocation anomaly (unfiled: no node bound — the journal
+    decision is what replays)."""
+    plane = RemediationPlane(b"drill-replay", dry_run=dry_run)
+    m = StubMiner("m1")
+    plane.bind_miners([m])
+    seq = 0
+
+    def n(sys, kind, **detail):
+        nonlocal seq
+        seq += 1
+        plane.on_note(seq, sys, kind, detail)
+
+    n("perf", "regression", metric="encode", frm="ok", to="regressed",
+      window=1)
+    plane.tick()
+    m.repair_ingress_bytes += 4000
+    m.repair_recovered_bytes += 1000
+    plane.tick()
+    n("perf", "regression", metric="encode", frm="regressed", to="ok",
+      window=3)
+    for _ in range(12):
+        plane.tick()
+    n("chain", "anomaly", cls="equivocation", key="v2@9", to="active")
+    plane.tick()
+    return plane, m
+
+
+class TestWitnessReplay:
+    def test_same_seed_runs_are_byte_identical(self):
+        a, _ = _drive(dry_run=False)
+        b, _ = _drive(dry_run=False)
+        assert a.witness() == b.witness()
+        # the witness is non-trivial: fires, releases and a suppress-
+        # free ingress decision all made it in
+        events = [e["event"] for e in a.journal()]
+        assert events.count("fire") >= 3
+        assert events.count("release") >= 2
+
+    def test_dry_run_journals_identically_and_applies_nothing(self):
+        act, m_act = _drive(dry_run=False)
+        dry, m_dry = _drive(dry_run=True)
+        # byte-identical witness: ``applied`` is bookkeeping, not
+        # part of the replay contract
+        assert dry.witness() == act.witness()
+        assert all(e["applied"] is False for e in dry.journal())
+        assert any(e["applied"] for e in act.journal())
+        # the acting run really flipped the miner; the dry run
+        # tracked the same INTENDED trajectory without touching it
+        assert m_act.modes == ["fragments", "symbols"]
+        assert m_dry.modes == []
+        assert m_dry.repair_mode == "symbols"
+        assert dry.snapshot()["counters"]["applied"] == 0
+
+    def test_snapshot_metrics_and_rpc_shape(self):
+        plane, _ = _drive(dry_run=False)
+        snap = plane.snapshot()
+        assert snap["policies"] and snap["journal"]
+        assert snap["health"]["perf"]["encode"] == "ok"
+        m = plane.metrics()
+        assert m["cess_remediation_policies"] == 5
+        assert m["cess_remediation_fires_total"] >= 3
+        assert m["cess_remediation_dry_run"] == 0
+        assert all(k.startswith("cess_remediation_") for k in m)
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the autopilot scenario replays bit-identically
+# ---------------------------------------------------------------------------
+class TestAutopilotScenario:
+    def test_same_seed_action_logs_at_20_and_100_nodes(self):
+        sc = SCENARIOS["perf_regression_autopilot"]
+        for n_nodes in (20, 100):
+            a = run_scenario(sc, b"autopilot", n_nodes=n_nodes)
+            b = run_scenario(sc, b"autopilot", n_nodes=n_nodes)
+            assert a.witness() == b.witness(), n_nodes
+            assert a.remediation.witness() == b.remediation.witness()
+        # the scripted regressions were pinned AND released, applied
+        # for real (the scenario runs the acting plane)
+        journal = a.remediation.journal()
+        fired = [(e["policy"], e["key"]) for e in journal
+                 if e["event"] == "fire"]
+        assert ("perf-pin", "encode") in fired
+        assert ("perf-pin", "decode") in fired
+        assert all(e["applied"] for e in journal
+                   if e["event"] == "fire")
+        released = [e["key"] for e in journal
+                    if e["event"] == "release"]
+        assert "encode" in released and "decode" in released
+        # a later incident bundle embeds a non-empty journal tail
+        tails = [b_["snapshots"]["remediation"]["journal"]
+                 for b_ in a.reporter.bundles()
+                 if "remediation" in b_["snapshots"]]
+        assert tails and any(tails)
+
+
+# ---------------------------------------------------------------------------
+# invariant tripwires: both remediation checkers provably fire
+# ---------------------------------------------------------------------------
+class TestRemediationInvariantTripwires:
+    def _regressed_world(self, enabled):
+        pols = tuple(dataclasses.replace(p, enabled=enabled)
+                     if p.name == "perf-pin" else p
+                     for p in default_policies())
+        plane = RemediationPlane(b"tripwire", pols)
+        note(plane, 1, "perf", "regression", metric="encode",
+             frm="ok", to="regressed", window=1)
+        plane.tick()
+        plane.tick()
+        return types.SimpleNamespace(remediation=plane), plane
+
+    def test_coverage_fires_on_a_disabled_policy_world(self):
+        world, plane = self._regressed_world(enabled=False)
+        assert plane.edge_log()       # the edge WAS matched + recorded
+        with pytest.raises(InvariantViolation,
+                           match="remediation-coverage.*DISABLED"):
+            run_checks(world, ("remediation-coverage",))
+
+    def test_effective_fires_on_a_disabled_policy_world(self):
+        world, _ = self._regressed_world(enabled=False)
+        with pytest.raises(InvariantViolation,
+                           match="remediation-effective.*regressed"):
+            run_checks(world, ("remediation-effective",))
+
+    def test_both_hold_on_the_enabled_world(self):
+        world, _ = self._regressed_world(enabled=True)
+        run_checks(world, ("remediation-coverage",
+                           "remediation-effective"))
+
+    def test_effective_fires_when_the_hold_is_tampered_away(self, engine):
+        plane = RemediationPlane(b"tamper")
+        plane.bind_engine(engine)
+        note(plane, 1, "perf", "regression", metric="encode",
+             frm="ok", to="regressed", window=1)
+        plane.tick()
+        world = types.SimpleNamespace(remediation=plane)
+        run_checks(world, ("remediation-effective",))  # holds pre-tamper
+        # someone releases the monitor behind the plane's back
+        engine.monitors["codec"].release()
+        with pytest.raises(InvariantViolation,
+                           match="remediation-effective.*not held"):
+            run_checks(world, ("remediation-effective",))
+
+    def test_absent_plane_is_a_no_op(self):
+        world = types.SimpleNamespace(remediation=None)
+        run_checks(world, ("remediation-coverage",
+                           "remediation-effective"))

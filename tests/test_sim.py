@@ -305,6 +305,31 @@ def _assert_scenario_behavior(name, report):
         fed = report.fleet.federator.snapshot()
         assert len(fed["instances"]) == report.world.n
         assert fed["round"] >= 1
+    elif name == "perf_regression_autopilot":
+        # ISSUE 16: the scripted perf edges were auto-pinned and
+        # auto-released by the ACTING remediation plane — every fire
+        # applied, every engagement gone by the end, no flapping —
+        # and a later incident bundle embeds a non-empty action
+        # journal tail
+        plane = report.remediation
+        assert plane is not None and not plane.dry_run
+        journal = plane.journal()
+        fired = [(e["policy"], e["key"]) for e in journal
+                 if e["event"] == "fire"]
+        assert ("perf-pin", "encode") in fired
+        assert ("perf-pin", "decode") in fired
+        assert all(e["applied"] for e in journal
+                   if e["event"] == "fire")
+        released = [e["key"] for e in journal
+                    if e["event"] == "release"]
+        assert "encode" in released and "decode" in released
+        assert plane.engagements() == {}
+        assert plane.snapshot()["counters"]["flaps"] == 0
+        tails = [b["snapshots"]["remediation"]["journal"]
+                 for b in report.reporter.bundles()
+                 if "remediation" in b["snapshots"]]
+        assert tails and any(tails), \
+            "no bundle embedded the remediation journal tail"
     elif name == "equivocating_validator":
         # ISSUE 14: the forged twin block is detected as BABE-shaped
         # equivocation evidence (two hashes, one author, one slot) and

@@ -87,6 +87,51 @@ def test_root_cost_independent_of_state_size():
     assert rt.state.state_root() == rt.state.recompute_root()
 
 
+def test_prefix_index_matches_linear_scan():
+    """iter_prefix/count_prefix run off the (pallet, item) index; the
+    index must stay exact through put/delete/rollback/undo/rebuild."""
+    def oracle(s, *prefix):
+        n = len(prefix)
+        items = [(k[n:], v) for k, v in s.kv.items()
+                 if len(k) > n and k[:n] == prefix]
+        items.sort(key=lambda kv: repr(kv[0]))
+        return items
+
+    def check(s):
+        for pfx in (("file_bank",), ("file_bank", "file"),
+                    ("file_bank", "file", "a"), ("balances", "free"),
+                    ("nope",), ("nope", "item")):
+            assert list(s.iter_prefix(*pfx)) == oracle(s, *pfx), pfx
+            assert s.count_prefix(*pfx) == len(oracle(s, *pfx)), pfx
+
+    s = State()
+    for i in range(8):
+        s.put("file_bank", "file", f"a{i}", i)
+        s.put("file_bank", "deal", f"d{i}", i)
+        s.put("balances", "free", f"who{i}", i * D)
+    s.delete("file_bank", "file", "a3")
+    s.put("file_bank", "file", "a5", 99)        # overwrite
+    check(s)
+    # rolled-back writes must vanish from the index
+    s.begin_tx()
+    s.put("file_bank", "file", "tx-only", 1)
+    s.delete("file_bank", "deal", "d0")
+    s.rollback_tx()
+    check(s)
+    # a committed-then-rewound block (fork choice) must too
+    s.begin_tx()
+    s.put("file_bank", "file", "blk", 2)
+    s.delete("balances", "free", "who7")
+    undo = s.commit_tx_undo()
+    s.apply_undo(undo)
+    check(s)
+    # snapshot load path: wholesale kv swap + rebuild
+    s.kv = dict(s.kv)
+    s.rebuild_root_cache()
+    check(s)
+    assert s.state_root() == s.recompute_root()
+
+
 def test_event_index_matches_linear_scan():
     s = State()
     for b in range(30):

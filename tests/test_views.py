@@ -78,13 +78,31 @@ class TestViewerSmoke:
         assert "equivocation" in out
         assert "finality-stall" in out
 
+    def test_remediation_view_renders_the_remediation_status_fixture(
+            self, capsys):
+        # fixture dumped from one perf_regression_autopilot run
+        # (seed b"fixtures", 20 nodes): two perf-pin fire/release
+        # episodes live in its journal tail
+        mod = _viewer("remediation_view")
+        assert mod.main([_fixture("remediation_status.json")]) == 0
+        out = capsys.readouterr().out
+        assert "remediation plane" in out
+        assert "policy table (" in out
+        assert "engagements (" in out
+        assert "detector evidence (" in out
+        assert "action journal (" in out
+        assert "perf-pin" in out
+        assert "pin-reference" in out
+
     def test_viewers_reject_foreign_payloads(self):
         # each _load names its RPC in the rejection so an operator
         # who mixes up dump files learns which file they actually got
         for viewer, wrong in (("chain_view", "fleet_status.json"),
                               ("fleet_view", "chain_status.json"),
                               ("profile_view", "chain_status.json"),
-                              ("incident_view", "profile_dump.json")):
+                              ("incident_view", "profile_dump.json"),
+                              ("remediation_view",
+                               "chain_status.json")):
             mod = _viewer(viewer)
             with pytest.raises(SystemExit):
                 mod.main([_fixture(wrong)])

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Render a remediation-plane snapshot as a human-readable report.
+
+Input: a JSON file holding a ``cess_remediationStatus`` payload (the
+RemediationPlane snapshot) — fetch one with::
+
+    curl -s -d '{"jsonrpc":"2.0","id":1,
+                 "method":"cess_remediationStatus"}' \
+        127.0.0.1:9944 | jq .result > remediation.json
+    python tools/remediation_view.py remediation.json
+    python tools/remediation_view.py remediation.json --journal 50
+
+The report shows the policy table (trigger -> guard -> action ->
+release condition), the live engagements, the detector-health
+evidence map, and the count-sequenced action journal (fire / suppress
+/ release / flap decisions in exact order — there are no timestamps
+by design). Stdlib only; read-only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict) and "result" in payload \
+            and isinstance(payload["result"], dict):
+        payload = payload["result"]
+    if not isinstance(payload, dict) or "policies" not in payload:
+        raise SystemExit(f"{path}: not a cess_remediationStatus "
+                         "payload (no 'policies' section)")
+    return payload
+
+
+def _fmt_detail(detail: dict) -> str:
+    return " ".join(f"{k}={v!r}" for k, v in sorted(detail.items()))
+
+
+def _fmt_edge(pair) -> str:
+    return "/".join(str(p) for p in pair) if pair else "-"
+
+
+def _render_policies(snap: dict, out) -> None:
+    rows = snap.get("policies", [])
+    print(f"policy table ({len(rows)} row(s)):", file=out)
+    for p in rows:
+        guard = " ".join(f"{f}={v!r}" for f, v in p.get("match", [])) \
+            or "any"
+        release = _fmt_edge(p.get("release_on"))
+        if p.get("release_match"):
+            release += "[" + " ".join(
+                f"{f}={v!r}" for f, v in p["release_match"]) + "]"
+        if p.get("release_after"):
+            release += f" | re-probe after {p['release_after']}"
+        state = "" if p.get("enabled", True) else "  [DISABLED]"
+        print(f"  {p['name']:<22} {_fmt_edge(p['trigger']):<18} "
+              f"guard({guard}) -> {p['action']:<18} "
+              f"release: {release}  cooldown={p.get('cooldown')} "
+              f"max={p.get('max_fires')}{state}", file=out)
+
+
+def _render_engaged(snap: dict, out) -> None:
+    engaged = snap.get("engaged", {})
+    print(f"engagements ({len(engaged)} live):", file=out)
+    for key in sorted(engaged):
+        e = engaged[key]
+        print(f"  {key:<30} action={e.get('action')} "
+              f"fired_tick={e.get('fired_tick')} "
+              f"edge=#{e.get('edge')}", file=out)
+
+
+def _render_health(snap: dict, out) -> None:
+    health = snap.get("health", {})
+    live = {s: h for s, h in sorted(health.items()) if h}
+    print(f"detector evidence ({len(live)} subsystem(s)):", file=out)
+    for sub, states in live.items():
+        summary = " ".join(f"{k}={v}" for k, v in sorted(states.items()))
+        print(f"  {sub:<10} {summary}", file=out)
+
+
+def _render_journal(snap: dict, limit: int, out) -> None:
+    entries = snap.get("journal", [])[-limit:]
+    total = snap.get("journal_total", len(entries))
+    print(f"action journal (last {len(entries)} of {total}, "
+          f"seq order):", file=out)
+    for e in entries:
+        applied = "" if e.get("event") == "suppress" else (
+            " applied" if e.get("applied") else " NOT-applied")
+        reason = f" reason={e['reason']}" if e.get("reason") else ""
+        print(f"  #{e['seq']:>4} t{e['tick']:>4} "
+              f"{e['event']:<9} {e['policy']:<22} "
+              f"{e['action']:<18} key={e['key']!r}{reason}{applied} "
+              f"{_fmt_detail(e.get('detail', {}))}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a remediation-plane snapshot "
+                    "(cess_remediationStatus payload) as a "
+                    "human-readable report")
+    ap.add_argument("path", help="snapshot JSON (cess_remediationStatus "
+                                 "result)")
+    ap.add_argument("--journal", type=int, default=20, metavar="N",
+                    help="journal entries shown (default 20)")
+    args = ap.parse_args(argv)
+    snap = _load(args.path)
+    out = sys.stdout
+    mode = " [dry-run]" if snap.get("dry_run") else ""
+    c = snap.get("counters", {})
+    print(f"remediation plane{mode}: tick {snap.get('count')}, "
+          f"{snap.get('edges_total')} edge(s), "
+          f"{sum(snap.get('fires', {}).values())} fire(s), "
+          f"{c.get('suppressed', 0)} suppressed, "
+          f"{c.get('releases', 0)} release(s), "
+          f"{c.get('flaps', 0)} flap(s)", file=out)
+    _render_policies(snap, out)
+    _render_engaged(snap, out)
+    _render_health(snap, out)
+    _render_journal(snap, args.journal, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
