@@ -892,6 +892,38 @@ def bench_fleet(n_nodes: int, rounds: int = 5):
     }
 
 
+def bench_lint():
+    """cesslint_full_tree_s: wall seconds for one full in-process
+    cesslint scan of cess_tpu/ — every rule family, including the
+    interprocedural flow pass (call graph + thread roots + taint
+    fixpoint), over one shared parse. The quantity that decides
+    whether the analyzer stays a per-commit gate or decays into a
+    nightly job; the tier-1 suite pins the same scan under 10 s, so
+    the recorded number is the early-warning trend line. Host-only
+    python (no devices in the loop)."""
+    import os
+
+    from cess_tpu import analysis
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    result = analysis.lint_paths([os.path.join(here, "cess_tpu")],
+                                 root=here)
+    wall = time.perf_counter() - t0
+    baseline = analysis.load_baseline(
+        os.path.join(here, "tools", "cesslint_baseline.json"))
+    new, baselined = analysis.apply_baseline(result.findings, baseline)
+    return wall, {
+        "files": result.files,
+        "findings": len(new),
+        "baselined": len(baselined),
+        "suppressed": len(result.suppressed),
+        "stale_suppressions": len(result.stale_suppressions),
+        "rules": len(analysis.all_rules()),
+        "errors": len(result.errors),
+    }
+
+
 def bench_chainwatch(n_nodes: int, rounds: int = 5):
     """chainwatch_100node_scan_ms: wall ms for ONE chain-plane scan
     round at ``n_nodes`` — digest every node's consensus state (tail
@@ -977,11 +1009,11 @@ def main() -> None:
                     help="comma list: decode,speedup,repair,podr2,"
                          "pool,stream,degraded,traceov,adaptive,"
                          "encode,sim,fleet,profile,chainwatch,"
-                         "remediate")
+                         "remediate,lint")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "pool", "stream",
              "degraded", "traceov", "adaptive", "encode", "sim",
-             "fleet", "profile", "chainwatch", "remediate"}
+             "fleet", "profile", "chainwatch", "remediate", "lint"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -1443,6 +1475,19 @@ def main() -> None:
                     "doubles, spike/stall/deep-reorg detectors, "
                     "cess_tpu/obs/chainwatch); states built outside "
                     "the timed window; lower is better")
+
+    if "lint" in which:
+        # host-only python like the sim metric: the full scan runs
+        # under --smoke so the gate exercises the exact analyzer path
+        # the per-commit lint gate uses (ISSUE 17)
+        wall, extra = bench_lint()
+        # vs_baseline: against the 10 s per-commit budget the tier-1
+        # suite enforces — >=1.0 means the full-tree scan fits it
+        emit("cesslint_full_tree_s", wall, "s", 10.0 / wall, **extra,
+             method="wall seconds for one in-process lint_paths scan "
+                    "of cess_tpu/ with every rule family, including "
+                    "the interprocedural flow fixpoint "
+                    "(cess_tpu/analysis/flow.py); lower is better")
 
     if "encode" in which:
         emit("rs_4p8_encode_GiBps_per_chip", encode_gibps, "GiB/s",

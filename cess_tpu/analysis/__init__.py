@@ -1,10 +1,16 @@
 """cesslint: AST-based static analysis for the cess_tpu codebase.
 
-Three rule families over one shared parse (core.py):
+Rule families over one shared parse (core.py) and one shared
+interprocedural pass (flow.py — call graph, thread roots, taint):
 
 - trace-safety (trace_safety.py)      — ops/, serve/
 - lock-discipline (lock_discipline.py) — serve/, node/
 - consensus-determinism (determinism.py) — chain/
+- sim-determinism (sim_determinism.py) — sim/, obs/ planes
+- span-balance (span_balance.py)       — serve/, node/, obs/
+- witness-purity (witness_purity.py)   — package-wide taint flow
+- race (race.py)                       — cross-thread lock sets
+- seam-cost (seam_cost.py)             — zero-cost hook guards
 
 CLI: ``python tools/cesslint.py [paths] [--rule ID] [--json]
 [--fix-hints] [--baseline FILE] [--write-baseline]``. Gate:
@@ -12,12 +18,13 @@ tests/test_lint.py (tier-1). Suppress a single true positive with
 ``# cesslint: disable=<rule-id>`` on (or directly above) the line;
 bulk legacy debt goes in tools/cesslint_baseline.json.
 """
-from .core import (Finding, LintResult, ParsedModule, Rule, all_rules,
-                   apply_baseline, lint_modules, lint_paths, lint_source,
-                   load_baseline, write_baseline)
+from .core import (Directive, Finding, LintResult, ParsedModule, Rule,
+                   all_rules, apply_baseline, lint_modules, lint_paths,
+                   lint_source, load_baseline, sarif_report,
+                   write_baseline)
 
 __all__ = [
-    "Finding", "LintResult", "ParsedModule", "Rule", "all_rules",
-    "apply_baseline", "lint_modules", "lint_paths", "lint_source",
-    "load_baseline", "write_baseline",
+    "Directive", "Finding", "LintResult", "ParsedModule", "Rule",
+    "all_rules", "apply_baseline", "lint_modules", "lint_paths",
+    "lint_source", "load_baseline", "sarif_report", "write_baseline",
 ]

@@ -113,8 +113,9 @@ def register(cls: type) -> type:
 
 def all_rules() -> dict[str, Rule]:
     """The registry with every rule family imported."""
-    from . import (determinism, lock_discipline,  # noqa: F401
-                   sim_determinism, span_balance, trace_safety)
+    from . import (determinism, lock_discipline, race,  # noqa: F401
+                   seam_cost, sim_determinism, span_balance,
+                   trace_safety, witness_purity)
 
     return dict(_RULES)
 
@@ -141,8 +142,19 @@ def path_parts(path: str) -> tuple[str, ...]:
 _ALL = "*"
 
 
-def _parse_suppressions(source: str) -> dict[int, set[str]]:
-    out: dict[int, set[str]] = {}
+@dataclasses.dataclass(frozen=True)
+class Directive:
+    """One inline ``# cesslint: disable=...`` comment — kept as an
+    object (not just a line->rules map) so the stale-suppression
+    audit can ask, per directive and per rule id, whether anything
+    was actually silenced."""
+    line: int                    # the comment's own line
+    covers: tuple                # line numbers it suppresses
+    rules: frozenset             # rule ids, or {_ALL}
+
+
+def _parse_directives(source: str) -> list[Directive]:
+    out: list[Directive] = []
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
@@ -162,20 +174,29 @@ def _parse_suppressions(source: str) -> dict[int, set[str]]:
                              r"(?:\s*,\s*[A-Za-z0-9_\-]+)*)", rest[1:])
                 if not m:
                     continue
-                rules = {r.strip() for r in m.group(1).split(",")}
+                rules = frozenset(r.strip()
+                                  for r in m.group(1).split(","))
             elif rest == "":
-                rules = {_ALL}
+                rules = frozenset({_ALL})
             else:
                 # "disabled", "disable-next-line", ...: an unknown
                 # directive must NOT silently blanket-suppress
                 continue
-            lines = [tok.start[0]]
+            covers = [tok.start[0]]
             if tok.line[:tok.start[1]].strip() == "":
-                lines.append(tok.start[0] + 1)   # own-line comment
-            for ln in lines:
-                out.setdefault(ln, set()).update(rules)
+                covers.append(tok.start[0] + 1)   # own-line comment
+            out.append(Directive(line=tok.start[0],
+                                 covers=tuple(covers), rules=rules))
     except tokenize.TokenError:
         pass
+    return out
+
+
+def _parse_suppressions(source: str) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for d in _parse_directives(source):
+        for ln in d.covers:
+            out.setdefault(ln, set()).update(d.rules)
     return out
 
 
@@ -187,7 +208,11 @@ class ParsedModule:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
-        self.suppressions = _parse_suppressions(source)
+        self.directives = _parse_directives(source)
+        self.suppressions: dict[int, set[str]] = {}
+        for d in self.directives:
+            for ln in d.covers:
+                self.suppressions.setdefault(ln, set()).update(d.rules)
 
     def line(self, lineno: int) -> str:
         if 0 < lineno <= len(self.lines):
@@ -231,6 +256,10 @@ class LintResult:
     suppressed: list[Finding]           # silenced by inline comments
     errors: list[str]                   # unparseable files
     files: int = 0
+    # (path, comment line, rule ids that silenced nothing) — only
+    # meaningful when every rule family ran (the CLI forbids
+    # --audit-suppressions on a --rule-narrowed scan)
+    stale_suppressions: list = dataclasses.field(default_factory=list)
 
 
 def lint_modules(mods: list[ParsedModule],
@@ -252,8 +281,21 @@ def lint_modules(mods: list[ParsedModule],
         (suppressed if mod is not None and mod.is_suppressed(f)
          else active).append(f)
     active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    stale = []
+    for mod in mods:
+        for d in mod.directives:
+            silenced = {f.rule for f in suppressed
+                        if f.path == mod.path and f.line in d.covers
+                        and (_ALL in d.rules or f.rule in d.rules)}
+            if _ALL in d.rules:
+                if not silenced:
+                    stale.append((mod.path, d.line, (_ALL,)))
+                continue
+            unused = sorted(d.rules - silenced)
+            if unused:
+                stale.append((mod.path, d.line, tuple(unused)))
     return LintResult(findings=active, suppressed=suppressed,
-                      errors=[], files=len(mods))
+                      errors=[], files=len(mods), stale_suppressions=stale)
 
 
 def lint_source(source: str, path: str,
@@ -314,3 +356,65 @@ def apply_baseline(findings: list[Finding],
         else:
             new.append(f)
     return new, matched
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 export (code-review rendering: GitHub code scanning,
+# VS Code SARIF viewer)
+# ---------------------------------------------------------------------------
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def sarif_report(findings: list[Finding],
+                 rules: dict[str, Rule] | None = None) -> dict:
+    """The findings as a SARIF 2.1.0 log (one run, one driver). Rule
+    metadata (description + fix hint) rides in the driver's rules
+    array; each result carries ruleId, file/line/col and the
+    baseline fingerprint."""
+    rules = rules if rules is not None else all_rules()
+    used = sorted({f.rule for f in findings})
+    index = {rid: i for i, rid in enumerate(used)}
+    rule_meta = []
+    for rid in used:
+        rule = rules.get(rid)
+        entry: dict = {"id": rid}
+        if rule is not None:
+            entry["shortDescription"] = {"text": rule.description}
+            if rule.hint:
+                entry["help"] = {"text": rule.hint}
+        rule_meta.append(entry)
+    results = []
+    for f in findings:
+        message = f.message if not f.fix_hint \
+            else f"{f.message} (fix: {f.fix_hint})"
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+            "partialFingerprints": {
+                "cesslint/v1": f.fingerprint(),
+            },
+        })
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cesslint",
+                "informationUri":
+                    "https://github.com/cess-tpu/cess-tpu",
+                "rules": rule_meta,
+            }},
+            "results": results,
+        }],
+    }

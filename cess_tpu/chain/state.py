@@ -148,7 +148,6 @@ class State:
     def _prefix_keys(self, prefix: tuple) -> list[tuple]:
         """Candidate keys for a prefix, via the (pallet, item) index."""
         if len(prefix) >= 2:
-            # cesslint: disable=consensus-unordered-iter — callers sort
             return list(self._pfx.get(prefix[:2], ()))
         # 0- or 1-element prefix: walk the (small) bucket directory
         # cesslint: disable=consensus-unordered-iter — callers sort

@@ -231,6 +231,10 @@ class TelemetryStream:
         while True:
             rec = self._q.get()
             if rec is None:
+                # the worker owns the socket exclusively: tear it
+                # down HERE, not in close() — a join timeout must
+                # never leave two threads touching _sock/_next_try
+                self._drop_conn()
                 return
             sock = self._connect()
             if sock is None:
@@ -273,8 +277,6 @@ class TelemetryStream:
         except queue.Full:
             pass
         self._worker.join(timeout=timeout)
-        self._drop_conn()
-        self._next_try = 0.0
 
 
 def _spec_version(node) -> int:

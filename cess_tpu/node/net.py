@@ -169,6 +169,11 @@ class _Conn:
                 return
 
     def close(self) -> None:
+        # one-shot monotonic bool: both the drain thread (send error)
+        # and external callers only ever store False, a single
+        # GIL-atomic write with no read-modify-write — a lock would
+        # buy nothing (pinned by tests/test_lint.py)
+        # cesslint: disable=race
         self.alive = False
         try:
             self._q.put_nowait(None)   # unblock the sender thread
@@ -359,17 +364,24 @@ class NodeService:
             t.join(timeout=2.0)
 
     def _spawn(self, fn, *args) -> None:
-        # prune finished threads (per-request DHT handlers and publish
-        # cycles spawn continually; the join list must stay bounded)
-        if len(self._threads) > 64:
-            self._threads = [t for t in self._threads if t.is_alive()]
         t = threading.Thread(target=fn, args=args, daemon=True)
         t.start()
-        self._threads.append(t)
+        # prune finished threads (per-request DHT handlers and publish
+        # cycles spawn continually; the join list must stay bounded);
+        # the prune REBINDS the list, so an unguarded concurrent
+        # append from another loop could vanish from the join list
+        with self.lock:
+            if len(self._threads) > 64:
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
+            self._threads.append(t)
 
     def _record_error(self, msg: str) -> None:
-        self.errors.append(msg)
-        del self.errors[:-ERRORS_CAP]
+        # append+trim is two ops; recv loops and the author loop both
+        # report here
+        with self.lock:
+            self.errors.append(msg)
+            del self.errors[:-ERRORS_CAP]
 
     # -- connections --------------------------------------------------------
     def _accept_loop(self, srv: socket.socket) -> None:
@@ -485,14 +497,18 @@ class NodeService:
             return
         if not faults.allow("net.send"):
             return   # seeded chaos drop (cess_tpu/resilience/faults.py)
-        self.msgs_sent += 1
+        with self.lock:
+            self.msgs_sent += 1
         conn.send(codec.encode(self._envelope(msg)))
 
     def _mark_seen(self, digest: bytes) -> None:
-        self._seen.add(digest)
-        if len(self._seen) >= SEEN_CAP:
-            self._seen_old = self._seen
-            self._seen = set()
+        # the generation swap rebinds both sets; two threads swapping
+        # concurrently would drop a whole dedup generation
+        with self.lock:
+            self._seen.add(digest)
+            if len(self._seen) >= SEEN_CAP:
+                self._seen_old = self._seen
+                self._seen = set()
 
     def _was_seen(self, digest: bytes) -> bool:
         return digest in self._seen or digest in self._seen_old
@@ -515,7 +531,8 @@ class NodeService:
                     continue
                 if not faults.allow("net.send"):
                     continue   # seeded chaos drop, per conn like faults
-                self.msgs_sent += 1
+                with self.lock:
+                    self.msgs_sent += 1
                 conn.send(raw)
 
     def _send_status(self, conn: _Conn) -> None:
@@ -826,15 +843,17 @@ class NodeService:
             now = time.time()
             if now >= self._next_publish \
                     and not getattr(self, "_publishing", False):
-                self._next_publish = now + 10 * self.slot_time
-                self._publishing = True
+                with self.lock:
+                    self._next_publish = now + 10 * self.slot_time
+                    self._publishing = True
                 self._spawn(self._publish_once)
             # DHT upkeep: record expiry + stale-bucket refresh lookups
             # (libp2p Kademlia's periodic maintenance), off this thread
             if now >= self._next_dht_maint \
                     and not getattr(self, "_dht_mainting", False):
-                self._next_dht_maint = now + 20 * self.slot_time
-                self._dht_mainting = True
+                with self.lock:
+                    self._next_dht_maint = now + 20 * self.slot_time
+                    self._dht_mainting = True
                 self._spawn(self._dht_maintenance)
 
     # -- authority discovery (Kademlia; service.rs:508-537 role) -------------
@@ -947,7 +966,8 @@ class NodeService:
         try:
             self.publish_authorities()
         finally:
-            self._publishing = False
+            with self.lock:
+                self._publishing = False
 
     def _dht_maintenance(self) -> None:
         try:
@@ -959,7 +979,8 @@ class NodeService:
                     return
                 self._iter_lookup(target, want_value=False)
         finally:
-            self._dht_mainting = False
+            with self.lock:
+                self._dht_mainting = False
 
     def publish_authorities(self) -> None:
         """Publish a signed address record for every authority whose

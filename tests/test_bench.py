@@ -36,6 +36,7 @@ EXPECTED = (
     "ingress_bytes_per_recovered_byte",
     "remediation_react_rounds",
     "stream_encode_tag_remediated_GiBps",
+    "cesslint_full_tree_s",
 )
 
 
@@ -169,6 +170,15 @@ def test_bench_smoke_every_metric_finite():
     assert math.isfinite(rem["remediation_overhead_frac"])
     assert math.isfinite(rem["unremediated_GiBps"]) \
         and rem["unremediated_GiBps"] > 0
+    # the analyzer-cost pin (ISSUE 17): one full in-process cesslint
+    # scan of cess_tpu/ — every family including the interprocedural
+    # flow fixpoint — with the scan's own counters riding along so a
+    # silently-empty scan can't pass; the 10 s per-commit budget is
+    # the vs_baseline denominator
+    lint = got["cesslint_full_tree_s"]
+    assert lint["files"] > 50 and lint["rules"] >= 17
+    assert lint["findings"] == 0 and lint["errors"] == 0
+    assert lint["stale_suppressions"] == 0
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:

@@ -855,6 +855,380 @@ class TestSimDeterminism:
 
 
 # ---------------------------------------------------------------------------
+# interprocedural dataflow families (analysis/flow.py):
+# witness-purity, race, seam-cost
+# ---------------------------------------------------------------------------
+DIRTY_TAINT_CALL = """
+    import time
+
+    class Report:
+        def _stamp(self):
+            return time.monotonic()
+
+        def witness(self):
+            return (self._stamp(), 42)
+"""
+
+DIRTY_TAINT_FIELD = """
+    import time
+
+    class Report:
+        def __init__(self):
+            self.t0 = 0.0
+            self._journal = []
+
+        def start(self):
+            self.t0 = time.time()
+
+        def note(self, kind):
+            self._journal.append((kind, self.t0))
+"""
+
+CLEAN_TAINT = """
+    import time
+
+    class Report:
+        def __init__(self):
+            self.seq = 0
+            self.t0 = 0.0
+            self._journal = []
+
+        def start(self):
+            self.t0 = time.time()     # observed, never witnessed
+
+        def note(self, kind):
+            self.seq += 1
+            self._journal.append((self.seq, kind))   # count-sequenced
+
+        def witness(self):
+            return tuple(self._journal)
+
+        def uptime(self):
+            return time.time() - self.t0
+"""
+
+
+class TestWitnessPurity:
+    def test_taint_through_call(self):
+        r = lint(DIRTY_TAINT_CALL, "cess_tpu/node/fixture.py")
+        assert rules_at(r) == {"witness-purity"}
+        f = r.findings[0]
+        assert "time.monotonic" in f.message and "witness" in f.message
+
+    def test_taint_through_field(self):
+        r = lint(DIRTY_TAINT_FIELD, "cess_tpu/node/fixture.py")
+        assert rules_at(r) == {"witness-purity"}
+        assert "_journal" in r.findings[0].message
+        assert "time.time" in r.findings[0].message
+
+    def test_clean_twin_is_silent(self):
+        # wallclock observed for timing but kept OUT of the witness
+        # bytes — the house design, not a finding
+        r = lint(CLEAN_TAINT, "cess_tpu/node/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_order_escape_into_witness(self):
+        src = """
+            class Report:
+                def __init__(self):
+                    self._seen = {}
+                    self._journal = []
+
+                def note(self, key):
+                    self._seen[key] = True
+                    for k in self._seen.keys():
+                        self._journal.append(k)
+        """
+        r = lint(src, "cess_tpu/node/fixture.py")
+        assert rules_at(r) == {"witness-purity"}
+        assert "iteration order" in r.findings[0].message
+
+    def test_sorted_order_escape_is_clean(self):
+        src = """
+            class Report:
+                def __init__(self):
+                    self._seen = {}
+                    self._journal = []
+
+                def note(self, key):
+                    self._seen[key] = True
+                    for k in sorted(self._seen.keys()):
+                        self._journal.append(k)
+        """
+        r = lint(src, "cess_tpu/node/fixture.py")
+        assert r.findings == []
+
+
+DIRTY_RACE = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.count = 0
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def poke(self):
+            self.count = 0
+"""
+
+CLEAN_RACE = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            while True:
+                with self._lock:
+                    self.count += 1
+
+        def poke(self):
+            with self._lock:
+                self.count = 0
+"""
+
+
+class TestRace:
+    def test_two_thread_unguarded_write_fires(self):
+        r = lint(DIRTY_RACE, "cess_tpu/serve/fixture.py")
+        assert rules_at(r) == {"race"}
+        f = r.findings[0]
+        assert "Worker.count" in f.message
+        assert "thread:_run" in f.message and "caller" in f.message
+
+    def test_guarded_write_clean(self):
+        r = lint(CLEAN_RACE, "cess_tpu/serve/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_single_writer_multi_reader_exempt(self):
+        src = """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        self.count += 1
+
+                def snapshot(self):
+                    return self.count        # read-only: no guard needed
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        assert r.findings == []
+
+    def test_pre_thread_start_init_exempt(self):
+        # __init__ writes happen before the object is published to
+        # any thread — both fixtures above rely on it; make it explicit
+        r = lint(CLEAN_RACE, "cess_tpu/serve/fixture.py")
+        assert all("__init__" not in f.message for f in r.findings)
+
+    def test_listener_root_counts_as_a_thread(self):
+        src = """
+            import threading
+
+            class Plane:
+                def __init__(self, recorder):
+                    self.hits = 0
+                    recorder.add_listener(self.on_note)
+
+                def on_note(self, note):
+                    self.hits += 1
+
+                def reset(self):
+                    self.hits = 0
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        assert rules_at(r) == {"race"}
+        assert "listener:on_note" in r.findings[0].message
+
+
+DIRTY_SEAM = """
+    _RECORDER = None
+
+    def note(subsystem, kind):
+        payload = f"{subsystem}:{kind}"
+        rec = _RECORDER
+        if rec is None:
+            return
+        rec.note(payload)
+"""
+
+CLEAN_SEAM = """
+    _RECORDER = None
+
+    def note(subsystem, kind):
+        rec = _RECORDER
+        if rec is None:
+            return
+        payload = f"{subsystem}:{kind}"
+        rec.note(payload)
+"""
+
+
+class TestSeamCost:
+    def test_fat_disarmed_seam_fires(self):
+        r = lint(DIRTY_SEAM, "cess_tpu/obs/fixture.py")
+        assert rules_at(r) == {"seam-cost"}
+        assert "before the disarmed-seam guard" in r.findings[0].message
+
+    def test_one_load_clean(self):
+        r = lint(CLEAN_SEAM, "cess_tpu/obs/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+
+    def test_allocation_before_attr_seam_fires(self):
+        src = """
+            class Engine:
+                def _account(self, n):
+                    detail = {"rows": n}
+                    slo = self.slo
+                    if slo is None:
+                        return
+                    slo.observe(detail)
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        assert rules_at(r) == {"seam-cost"}
+
+    def test_contextvar_get_is_load_equivalent(self):
+        # the trace.event idiom: _CURRENT.get() before the guard is
+        # one load, not work
+        src = """
+            import contextvars
+
+            _CURRENT = contextvars.ContextVar("span", default=None)
+
+            def event(name):
+                sp = _CURRENT.get()
+                if sp is not None:
+                    sp.event(name)
+        """
+        r = lint(src, "cess_tpu/obs/fixture.py")
+        assert r.findings == []
+
+    def test_work_then_note_functions_are_not_seams(self):
+        # real work before a LATE guard is armed-and-disarmed work,
+        # not a seam violation (the audit stops at the first
+        # non-bind statement)
+        src = """
+            _RECORDER = None
+
+            class Engine:
+                def close(self):
+                    self._drain()
+                    rec = _RECORDER
+                    if rec is None:
+                        return
+                    rec.note("closed")
+
+                def _drain(self):
+                    pass
+        """
+        r = lint(src, "cess_tpu/serve/fixture.py")
+        assert r.findings == []
+
+    def test_registered_hook_without_guard_fires(self):
+        src = """
+            _RECORDER = None
+
+            def note(subsystem, kind):
+                print(subsystem, kind)
+        """
+        r = lint(src, "cess_tpu/obs/flight.py")
+        assert "seam-cost" in rules_at(r)
+        assert "registered zero-cost hook" in r.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# acceptance seeding: each contract violation planted in the REAL
+# tree produces exactly the expected finding (ISSUE 17 acceptance)
+# ---------------------------------------------------------------------------
+class TestSeededRegressions:
+    def test_wallclock_seeded_into_sim_witness_dataflow(self):
+        path = os.path.join(REPO, "cess_tpu", "sim", "scenarios.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        assert "    def witness(self) -> tuple:" in src
+        seeded = ("import time\n" + src).replace(
+            "    def witness(self) -> tuple:",
+            "    def _stamp(self) -> float:\n"
+            "        return time.monotonic()\n\n"
+            "    def witness(self) -> tuple:", 1).replace(
+            "        return (self.world.queue.fired_log(),",
+            "        return (self._stamp(),\n"
+            "                self.world.queue.fired_log(),", 1)
+        assert seeded != "import time\n" + src
+        r = analysis.lint_source(seeded, "cess_tpu/sim/scenarios.py")
+        # the interprocedural taint finding (plus the per-file
+        # sim-wallclock rule seeing the same read)
+        assert rules_at(r) == {"witness-purity", "sim-wallclock"}
+        wp = [f for f in r.findings if f.rule == "witness-purity"]
+        assert len(wp) == 1
+        assert "SimReport.witness" in wp[0].message
+        assert "time.monotonic" in wp[0].message
+
+    def test_unguarded_cross_thread_write_seeded_into_engine(self):
+        path = os.path.join(REPO, "cess_tpu", "serve", "engine.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        anchor = "    def _run(self) -> None:"
+        assert anchor in src
+        seeded = src.replace(
+            anchor,
+            "    def poke_seeded(self) -> None:\n"
+            "        self._seeded_counter = 1\n\n"
+            + anchor + "\n        self._seeded_counter = 2", 1)
+        r = analysis.lint_source(seeded, "cess_tpu/serve/engine.py")
+        assert rules_at(r) == {"race"}
+        assert len(r.findings) == 1
+        assert "_seeded_counter" in r.findings[0].message
+        assert "thread:_run" in r.findings[0].message
+
+    def test_allocation_seeded_before_flight_note_guard(self):
+        path = os.path.join(REPO, "cess_tpu", "obs", "flight.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        guard = ("    rec = _RECORDER\n"
+                 "    if rec is None:\n"
+                 "        return\n")
+        assert guard in src
+        seeded = src.replace(
+            guard,
+            "    payload = f\"{subsystem}:{kind}\"\n" + guard, 1)
+        r = analysis.lint_source(seeded, "cess_tpu/obs/flight.py")
+        assert rules_at(r) == {"seam-cost"}
+        assert len(r.findings) == 1
+        assert "payload" in r.findings[0].message
+
+    def test_net_conn_alive_race_suppression_is_load_bearing(self):
+        # the one in-tree race suppression (monotonic one-shot bool in
+        # _Conn.close): still needed, still justified
+        path = os.path.join(REPO, "cess_tpu", "node", "net.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        r = analysis.lint_source(src, "cess_tpu/node/net.py")
+        assert r.findings == []
+        assert [f.rule for f in r.suppressed] == ["race"]
+        assert "_Conn.alive" in r.suppressed[0].message
+        stripped = src.replace("        # cesslint: disable=race\n", "")
+        assert stripped != src
+        r2 = analysis.lint_source(stripped, "cess_tpu/node/net.py")
+        assert [f.rule for f in r2.findings] == ["race"]
+        assert "_Conn.alive" in r2.findings[0].message
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline workflow
 # ---------------------------------------------------------------------------
 class TestSuppression:
@@ -951,6 +1325,244 @@ class TestBaseline:
 
 
 # ---------------------------------------------------------------------------
+# suppression audit (--audit-suppressions): inline disables that no
+# longer silence anything are debt, not documentation
+# ---------------------------------------------------------------------------
+STALE_SUPPRESS = """
+    SAFE = 1  # cesslint: disable=consensus-wallclock — long fixed
+"""
+
+LIVE_SUPPRESS = """
+    import time
+
+    T = time.time()  # cesslint: disable=consensus-wallclock
+"""
+
+
+class TestSuppressionAudit:
+    def test_stale_directive_reported(self):
+        r = lint(STALE_SUPPRESS, "cess_tpu/chain/fixture.py")
+        assert r.findings == [] and r.suppressed == []
+        assert r.stale_suppressions == [
+            ("cess_tpu/chain/fixture.py", 2, ("consensus-wallclock",))]
+
+    def test_load_bearing_directive_not_reported(self):
+        r = lint(LIVE_SUPPRESS, "cess_tpu/chain/fixture.py")
+        assert [f.rule for f in r.suppressed] == ["consensus-wallclock"]
+        assert r.stale_suppressions == []
+
+    def test_partially_stale_directive_names_the_dead_id(self):
+        src = """
+            import time
+
+            T = time.time()  # cesslint: disable=consensus-wallclock,consensus-float
+        """
+        r = lint(src, "cess_tpu/chain/fixture.py")
+        assert [f.rule for f in r.suppressed] == ["consensus-wallclock"]
+        assert r.stale_suppressions == [
+            ("cess_tpu/chain/fixture.py", 4, ("consensus-float",))]
+
+    def test_bare_disable_stale_only_when_nothing_silenced(self):
+        live = lint("""
+            import time
+
+            T = time.time()  # cesslint: disable
+        """, "cess_tpu/chain/fixture.py")
+        assert live.stale_suppressions == []
+        dead = lint("SAFE = 1  # cesslint: disable\n",
+                    "cess_tpu/chain/fixture.py")
+        assert dead.stale_suppressions == [
+            ("cess_tpu/chain/fixture.py", 1, ("*",))]
+
+    def test_repo_has_no_stale_suppressions(self):
+        r = analysis.lint_paths([os.path.join(REPO, "cess_tpu")],
+                                root=REPO)
+        assert r.stale_suppressions == []
+
+    def test_cli_audit_dirty_and_clean(self, tmp_path):
+        d = tmp_path / "chain"
+        d.mkdir()
+        stale = d / "stale.py"
+        stale.write_text(textwrap.dedent(STALE_SUPPRESS))
+        # without the flag, a stale disable is invisible (exit 0)
+        code, out = _run_cli(str(stale), "--no-baseline")
+        assert code == 0, out
+        code, out = _run_cli(str(stale), "--no-baseline",
+                             "--audit-suppressions")
+        assert code == 1
+        assert "stale suppression" in out
+        assert "consensus-wallclock" in out
+        live = d / "live.py"
+        live.write_text(textwrap.dedent(LIVE_SUPPRESS))
+        code, out = _run_cli(str(live), "--no-baseline",
+                             "--audit-suppressions")
+        assert code == 0, out
+
+    def test_cli_audit_forbids_rule_filter(self):
+        # a narrowed run would mark every other family's suppression
+        # stale — refuse instead of lying
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "cesslint.py"),
+             "--audit-suppressions", "--rule", "race"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 2
+        assert "drop --rule" in proc.stderr
+
+    def test_cli_audit_json_shape(self, tmp_path):
+        d = tmp_path / "chain"
+        d.mkdir()
+        stale = d / "stale.py"
+        stale.write_text(textwrap.dedent(STALE_SUPPRESS))
+        code, out = _run_cli(str(stale), "--no-baseline",
+                             "--audit-suppressions", "--json")
+        assert code == 1
+        data = json.loads(out)
+        assert data["findings"] == []
+        assert len(data["stale_suppressions"]) == 1
+        entry = data["stale_suppressions"][0]
+        assert entry["line"] == 2
+        assert entry["rules"] == ["consensus-wallclock"]
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 export
+# ---------------------------------------------------------------------------
+# offline structural schema: the required-property skeleton of SARIF
+# 2.1.0 (the full OASIS schema needs network access to fetch; this
+# pins the invariants code-scanning consumers actually reject on)
+SARIF_MINI_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["message"],
+                            "properties": {
+                                "ruleId": {"type": "string"},
+                                "ruleIndex": {"type": "integer",
+                                              "minimum": 0},
+                                "level": {"enum": ["none", "note",
+                                                   "warning", "error"]},
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "locations": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "required":
+                                                    ["artifactLocation"],
+                                                "properties": {
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum":
+                                                                1},
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+class TestSarif:
+    def _validate(self, doc):
+        jsonschema = pytest.importorskip("jsonschema")
+        jsonschema.validate(doc, SARIF_MINI_SCHEMA)
+
+    def test_report_structure_and_schema(self):
+        r = lint(DIRTY_LOCK, "cess_tpu/serve/fixture.py")
+        assert r.findings
+        doc = analysis.sarif_report(r.findings, analysis.all_rules())
+        self._validate(doc)
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "cesslint"
+        assert len(run["results"]) == len(r.findings)
+        rule_ids = [m["id"] for m in run["tool"]["driver"]["rules"]]
+        assert rule_ids == sorted(set(rule_ids))    # deduped + sorted
+        for res, f in zip(run["results"], r.findings):
+            assert res["ruleId"] == f.rule
+            assert rule_ids[res["ruleIndex"]] == f.rule
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"] == f.path
+            assert loc["region"]["startLine"] == f.line
+            assert res["partialFingerprints"]["cesslint/v1"] \
+                == f.fingerprint()
+        # driver rules carry the human metadata
+        assert all("shortDescription" in m
+                   for m in run["tool"]["driver"]["rules"])
+
+    def test_empty_report_is_still_valid(self):
+        doc = analysis.sarif_report([])
+        self._validate(doc)
+        assert doc["runs"][0]["results"] == []
+        assert doc["runs"][0]["tool"]["driver"]["rules"] == []
+
+    def test_cli_writes_sarif_log(self, tmp_path):
+        bad = tmp_path / "serve" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text(textwrap.dedent(DIRTY_LOCK))
+        out_path = tmp_path / "out.sarif"
+        code, _ = _run_cli(str(bad), "--no-baseline",
+                           "--sarif", str(out_path))
+        assert code == 1
+        with open(out_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        self._validate(doc)
+        assert {r["ruleId"] for r in doc["runs"][0]["results"]} == {
+            "lock-unguarded-write", "lock-blocking-call",
+            "lock-order-cycle"}
+
+
+# ---------------------------------------------------------------------------
 # the repo gate + CLI
 # ---------------------------------------------------------------------------
 def test_repo_is_clean_and_fast():
@@ -1040,5 +1652,6 @@ class TestCli:
         for rid in ("trace-host-sync", "dtype-overflow",
                     "lock-unguarded-write", "lock-order-cycle",
                     "consensus-unordered-iter", "consensus-wallclock",
-                    "consensus-float", "span-balance"):
+                    "consensus-float", "span-balance",
+                    "witness-purity", "race", "seam-cost"):
             assert rid in out

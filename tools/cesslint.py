@@ -12,9 +12,13 @@ Usage:
                                 findings (accept existing debt)
         [--json]                machine-readable output
         [--fix-hints]           print the suggested edit per finding
+        [--sarif PATH]          also write findings as SARIF 2.1.0
+        [--audit-suppressions]  report stale inline disables (rule
+                                ids that no longer silence anything)
 
 Exit status: 0 when no unsuppressed, unbaselined findings; 1 otherwise
-(2 on unparseable files). Suppress one finding inline with
+(2 on unparseable files; with --audit-suppressions, 1 on stale
+suppressions too). Suppress one finding inline with
 ``# cesslint: disable=<rule-id>`` on (or directly above) its line.
 """
 from __future__ import annotations
@@ -46,6 +50,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--fix-hints", action="store_true")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="write findings as a SARIF 2.1.0 log")
+    ap.add_argument("--audit-suppressions", action="store_true",
+                    help="report inline disables that silence nothing")
     args = ap.parse_args(argv)
 
     rules = analysis.all_rules()
@@ -61,6 +69,13 @@ def main(argv: list[str] | None = None) -> int:
                   "--list-rules shows valid ids", file=sys.stderr)
             return 2
         rules = {rid: rules[rid] for rid in wanted}
+
+    if args.audit_suppressions and args.rule:
+        # a narrowed run sees only its own families' findings, so
+        # every other family's suppression would look stale
+        print("--audit-suppressions requires every rule family "
+              "(drop --rule)", file=sys.stderr)
+        return 2
 
     if args.write_baseline and (args.rule or args.paths):
         # a narrowed scan would silently drop every baseline entry
@@ -93,11 +108,21 @@ def main(argv: list[str] | None = None) -> int:
               f"({len(result.findings)} finding(s) accepted)")
         return 0
 
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(analysis.sarif_report(new, rules), fh, indent=1)
+            fh.write("\n")
+
+    stale = result.stale_suppressions if args.audit_suppressions else []
+
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_json() for f in new],
             "baselined": len(baselined),
             "suppressed": len(result.suppressed),
+            "stale_suppressions": [
+                {"path": p, "line": ln, "rules": list(rids)}
+                for p, ln, rids in stale],
             "files": result.files,
             "errors": result.errors,
             "seconds": round(elapsed, 3),
@@ -105,6 +130,10 @@ def main(argv: list[str] | None = None) -> int:
     else:
         for f in new:
             print(f.format(hints=args.fix_hints))
+        for p, ln, rids in stale:
+            print(f"{p}:{ln}: stale suppression — "
+                  f"`# cesslint: disable={','.join(rids)}` no longer "
+                  "silences any finding; delete it (or the rule id)")
         for e in result.errors:
             print(f"parse error: {e}", file=sys.stderr)
         print(f"cesslint: {len(new)} finding(s) "
@@ -113,7 +142,7 @@ def main(argv: list[str] | None = None) -> int:
               f"[{elapsed:.2f}s]")
     if result.errors:
         return 2
-    return 1 if new else 0
+    return 1 if new or stale else 0
 
 
 if __name__ == "__main__":
