@@ -14,6 +14,14 @@ byte-exact against the NumPy oracle (cess_tpu/ops/rs_ref.py):
   the MXU followed by ``& 1``. 8x bit expansion, but all FLOPs land on
   the systolic array. (A Pallas-fused variant that keeps the expansion
   in VMEM lives in cess_tpu/ops/rs_pallas.py.)
+- ``xor``: the bitmatrix compiled ONCE into a CSE'd XOR schedule
+  (cess_tpu/ops/xor_sched.py) executed bit-sliced on the VPU
+  (cess_tpu/ops/rs_xor.py) — sparse work instead of the dense 8x
+  expansion.
+- ``auto``: a compile-time cost model picks dense vs scheduled-XOR per
+  (matrix, dispatch shape); the choice is recorded in cache_meta so
+  program-cache keys attribute it. Explicit ``strategy=`` always
+  forces.
 
 Geometry (k, m) is first-class (reference pins FRAGMENT_COUNT=3 i.e.
 RS(2,1), /root/reference/runtime/src/lib.rs:1026-1027; BASELINE.json
@@ -34,7 +42,7 @@ import numpy as np
 from ..resilience import faults
 from . import gf
 
-Strategy = str  # "gather" | "bitmatrix" | "pallas" (fused bitmatrix, TPU default)
+Strategy = str  # "gather" | "bitmatrix" | "pallas" | "xor" | "auto"
 
 # ---------------------------------------------------------------------------
 # Table construction (host side, tiny)
@@ -141,8 +149,57 @@ class _MatrixApply:
             self._bmat = jnp.asarray(self._bmat_np, dtype=jnp.bfloat16)
         elif strategy == "pallas":
             self._bmat_np = gf.expand_bitmatrix(self.mat)
+        elif strategy == "xor":
+            from . import xor_sched  # local: default strategies never pay it
+
+            self._sched = xor_sched.compile_schedule(
+                gf.expand_bitmatrix(self.mat))
+        elif strategy == "auto":
+            # compile-time cost model: bake BOTH lowerings, pick per
+            # dispatch shape (the decision is pure arithmetic over
+            # static shapes — results never change, only which program
+            # serves them; cache_meta records the choice)
+            from . import xor_sched
+
+            self._sched = xor_sched.compile_schedule(
+                gf.expand_bitmatrix(self.mat))
+            self._auto_base = _MatrixApply(self.mat, default_strategy())
         else:
             raise ValueError(f"unknown strategy {strategy!r}")
+
+    def _decide(self, shape) -> dict:
+        """Cost-model verdict for one data shape (strategy="auto")."""
+        from . import xor_sched
+
+        rows = 1
+        for d in shape[:-2]:
+            rows *= int(d)
+        return xor_sched.estimate(self._sched.r8, self._sched.q8,
+                                  self._sched.n_xors,
+                                  xor_sched.rows_bucket(rows))
+
+    def cache_meta(self, shape) -> tuple:
+        """Program-cache key components attributing this apply: the
+        strategy that serves ``shape`` plus the cost-model estimate
+        (nested str/int tuples, so they ride ProgramCache keys into
+        OpProfiler/CompileLedger verbatim). Empty — zero cache-key
+        growth — for the dense default strategies."""
+        if self.strategy == "auto":
+            est = self._decide(tuple(shape))
+            return (("strategy", "auto:" + est["chosen"]),
+                    ("dense_cost", est["dense_cost"]),
+                    ("xor_cost", est["xor_cost"]),
+                    ("n_xors", est["n_xors"]))
+        if self.strategy == "xor":
+            return (("strategy", "xor"),
+                    ("n_xors", self._sched.n_xors),
+                    ("dense_xors", self._sched.dense_xors))
+        return ()
+
+    def _apply_xor(self, data: jax.Array) -> jax.Array:
+        from . import rs_xor
+
+        return rs_xor.apply_schedule(self._sched, data)
 
     def __call__(self, data: jax.Array) -> jax.Array:
         if data.shape[-2] != self.mat.shape[1]:
@@ -153,6 +210,12 @@ class _MatrixApply:
             return _apply_gather(self._lo, self._hi, data)
         if self.strategy == "pallas":
             return _pallas_apply(self._bmat_np, data)
+        if self.strategy == "xor":
+            return self._apply_xor(data)
+        if self.strategy == "auto":
+            if self._decide(data.shape)["chosen"] == "xor":
+                return self._apply_xor(data)
+            return self._auto_base(data)
         return _apply_bitmatrix(self._bmat, data)
 
     def aot(self, shape, dtype=jnp.uint8, device=None):
@@ -314,6 +377,22 @@ class TPUCodec:
         faults.inject("rs.decode")
         apply_ = self._matrix_for("decode", tuple(present))
         return apply_(jnp.asarray(survivors, dtype=jnp.uint8))
+
+    def program_meta(self, kind: str, present=(), missing=(),
+                     shape=()) -> tuple:
+        """Program-cache key metadata for one engine op: which strategy
+        serves (kind, pattern, shape) and the cost-model estimate that
+        picked it (serve/engine.py appends this to ProgramCache keys so
+        OpProfiler/CompileLedger attribute the choice). Returns () — no
+        key growth at all — unless this codec runs strategy "xor" or
+        "auto"; the default strategies stay invisible here."""
+        if self.strategy not in ("xor", "auto"):
+            return ()
+        if kind == "encode":
+            apply_ = self._parity_apply
+        else:
+            apply_ = self._matrix_for(kind, tuple(present), tuple(missing))
+        return apply_.cache_meta(tuple(shape))
 
 
 # ---------------------------------------------------------------------------

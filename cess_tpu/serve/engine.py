@@ -565,11 +565,17 @@ class SubmissionEngine:
             present, missing = tuple(present), tuple(missing)
             for b in buckets:
                 bucket = bucket_rows(b)
+                # the warm key must carry the same cost-model meta
+                # _op_repair's lookup appends, or the warmed entry
+                # never hits
+                meta = self._codec_meta(self.codec, "repair", present,
+                                        missing,
+                                        (bucket, len(present), n))
                 if warm is not None:
                     warm(present, missing,
                          (bucket, len(present), n))
                 self.programs.get(
-                    ("repair", present, missing, n, bucket),
+                    ("repair", present, missing, n, bucket) + meta,
                     lambda p=present, mi=missing:
                         (lambda a: self.codec.reconstruct(a, p, mi)))
                 # pool path: pre-populate EVERY lane's slice of the
@@ -585,7 +591,7 @@ class SubmissionEngine:
                              device=lane.device)
                     self.programs.get(
                         self._key(("repair", present, missing, n,
-                                   bucket), False, lane),
+                                   bucket), False, lane) + meta,
                         lambda p=present, mi=missing:
                             (lambda a: self.codec.reconstruct(a, p,
                                                               mi)))
@@ -610,16 +616,18 @@ class SubmissionEngine:
         for c in sorted(coeffs):
             for b in buckets:
                 bucket = bucket_rows(b)
+                meta = self._codec_meta(self.codec, "symbol", (c,), (),
+                                        (bucket, 2, n))
                 warm_fold(c, (bucket, 2, n))
                 self.programs.get(
-                    ("symbol", c, n, bucket),
+                    ("symbol", c, n, bucket) + meta,
                     lambda cc=c:
                         (lambda a: self.codec.fold_symbol(a, cc)))
                 for lane in lanes:
                     warm_fold(c, (bucket, 2, n), device=lane.device)
                     self.programs.get(
                         self._key(("symbol", c, n, bucket), False,
-                                  lane),
+                                  lane) + meta,
                         lambda cc=c:
                             (lambda a: self.codec.fold_symbol(a, cc)))
 
@@ -1396,6 +1404,20 @@ class SubmissionEngine:
         return jax.default_device(lane.device)
 
     @staticmethod
+    def _codec_meta(codec, kind, present=(), missing=(), shape=()) -> tuple:
+        """Cost-model attribution components for a program-cache key:
+        codecs that auto-select a lowering (TPUCodec.program_meta,
+        strategy="xor"/"auto") report which strategy serves this
+        (kind, pattern, shape) plus the estimate that picked it, so
+        OpProfiler/CompileLedger keep the programs apart. Zero-cost
+        seam: one load + None check, and default-strategy codecs
+        return () — cache keys grow only when the selector is armed."""
+        meta = getattr(codec, "program_meta", None)
+        if meta is None:
+            return ()
+        return meta(kind, present=present, missing=missing, shape=shape)
+
+    @staticmethod
     def _key(key: tuple, degraded: bool, lane=None) -> tuple:
         """Degraded programs cache under their own keys — a breaker
         flip must never hand a device program a CPU batch or vice
@@ -1416,8 +1438,9 @@ class SubmissionEngine:
         total = data.shape[0]
         bucket = bucket_rows(total)
         _, k, n = data.shape
+        meta = self._codec_meta(codec, "encode", shape=(bucket, k, n))
         prog = self.programs.get(self._key(("encode", k, n, bucket),
-                                           degraded, lane),
+                                           degraded, lane) + meta,
                                  lambda: codec.encode)
         out = prog(_pad_axis0(data, bucket))[:total]
         return self._split_rows(batch, out), bucket
@@ -1432,9 +1455,11 @@ class SubmissionEngine:
         n = surv.shape[2]
         if kind == "reconstruct":
             present, missing = aux["present"], aux["missing"]
+            meta = self._codec_meta(codec, "repair", present, missing,
+                                    (bucket, len(present), n))
             prog = self.programs.get(
                 self._key(("repair", present, missing, n, bucket),
-                          degraded, lane),
+                          degraded, lane) + meta,
                 lambda: (lambda a: codec.reconstruct(a, present,
                                                      missing)))
         elif kind == "symbol":
@@ -1447,15 +1472,21 @@ class SubmissionEngine:
                 from ..ops import regen
 
                 fold = regen.fold_symbol_pairs
+                meta = ()
+            else:
+                meta = self._codec_meta(codec, "symbol", (coeff,), (),
+                                        (bucket, 2, n))
             prog = self.programs.get(
                 self._key(("symbol", coeff, n, bucket), degraded,
-                          lane),
+                          lane) + meta,
                 lambda f=fold, c=coeff: (lambda a: f(a, c)))
         else:
             present = aux["present"]
+            meta = self._codec_meta(codec, "decode", present, (),
+                                    (bucket, len(present), n))
             prog = self.programs.get(
                 self._key(("decode", present, n, bucket), degraded,
-                          lane),
+                          lane) + meta,
                 lambda: (lambda a: codec.decode_data(a, present)))
         out = prog(_pad_axis0(surv, bucket))[:total]
         return self._split_rows(batch, out), bucket

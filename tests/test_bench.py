@@ -37,6 +37,8 @@ EXPECTED = (
     "remediation_react_rounds",
     "stream_encode_tag_remediated_GiBps",
     "cesslint_full_tree_s",
+    "rs_xor_encode_GiBps_per_chip",
+    "xor_schedule_saving_frac",
 )
 
 
@@ -62,6 +64,22 @@ def test_bench_smoke_every_metric_finite():
                   "cpu_times_ms"):
         assert field in speedup, field
     assert len(speedup["cpu_times_ms"]) >= 5
+    # r06 protocol fix (ISSUE 18 satellite): BOTH sides of the ratio
+    # run min-of-3-windows, and the baseline's per-window rates ride
+    # the record so drift is attributable to one side
+    assert len(speedup["cpu_window_GiBps"]) == 3
+    assert len(speedup["device_window_GiBps"]) == 3
+    assert speedup["cpu_GiBps"] == max(speedup["cpu_window_GiBps"])
+    # the XOR-scheduled codec pins (ISSUE 18): the scheduled encode
+    # row carries the dense-vs-CSE'd op counts, and the compiler
+    # clears the >= 25% reduction acceptance bar on the (4,8) matrix
+    xor = got["rs_xor_encode_GiBps_per_chip"]
+    assert xor["n_xors"] < xor["dense_xors"]
+    assert xor["scratch_high_water"] >= 1
+    saving = got["xor_schedule_saving_frac"]
+    assert saving["value"] >= 0.25
+    assert saving["value"] == round(
+        1.0 - saving["n_xors"] / saving["dense_xors"], 3)
     # warm repair is measured separately from cold dispatch
     warm = got["fragment_repair_warm_p99_ms"]
     assert warm["cold_compile_first_call_ms"] > 0
@@ -254,6 +272,11 @@ class TestBenchDiff:
         assert bench_diff.lower_is_better("repair_storm_drain_s")
         assert not bench_diff.lower_is_better(
             "repair_storm_orders_per_s")
+        # ISSUE 18 satellite: the CSE saving fraction regresses
+        # DOWNWARD (bigger saving = fewer ops = better), explicitly —
+        # and adding it flips no wall-clock name
+        assert not bench_diff.lower_is_better("xor_schedule_saving_frac")
+        assert bench_diff.lower_is_better("anything_else_ending_in_s")
 
     def test_default_against_is_the_next_lower_round(self, tmp_path,
                                                       monkeypatch):

@@ -110,8 +110,11 @@ def newest_rounds() -> list[str]:
 def lower_is_better(metric: str) -> bool:
     # latencies (_ms), wall-clock drains (_s) and repair-cost ratios
     # (_per_recovered_byte) regress UPWARD; rates (_per_s, _GiBps, _x)
-    # regress downward — "_s" must not swallow throughput names like
-    # podr2_..._frags_per_s
+    # and schedule-compiler savings (_saving_frac: the CSE'd XOR
+    # reduction, bigger = fewer ops) regress downward — "_s" must not
+    # swallow throughput names like podr2_..._frags_per_s
+    if metric.endswith("_saving_frac"):
+        return False
     return metric.endswith("_ms") or (
         metric.endswith("_s") and not metric.endswith("_per_s")) or \
         metric.endswith("_per_recovered_byte")

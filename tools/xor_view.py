@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Render a compiled XOR-schedule dump as a human-readable report.
+
+Input: a JSON file holding an ``xor_schedule_dump`` payload — the
+compiled schedules (``XorSchedule.dump()``) plus the engine's cached
+codec programs with their strategy attribution (the cost-model meta
+components ``serve/engine.py`` appends to program-cache keys under
+``strategy="xor"``/``"auto"``). Produce one with ``collect``::
+
+    python - <<'PY'
+    import json
+    from cess_tpu.serve.engine import make_engine
+    from tools.xor_view import collect
+    eng = make_engine(2, 1, rs_backend="jax", strategy="auto")
+    ...  # drive some traffic
+    json.dump(collect(eng), open("xor_dump.json", "w"))
+    PY
+    python tools/xor_view.py xor_dump.json
+
+The report shows, per compiled schedule: the bitmatrix geometry, the
+dense vs CSE'd XOR counts and saving fraction, the liveness-allocated
+scratch high-water mark and the op mix; per cached program: the cache
+key, whether the strategy was forced ("xor") or cost-model chosen
+("auto:xor" / "auto:dense"), and the estimates that picked it.
+Rendering is stdlib only; read-only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def collect(engine) -> dict:
+    """Assemble an ``xor_schedule_dump`` payload from a live engine:
+    every compiled schedule reachable through the codec's matrix
+    caches plus every program-cache key carrying strategy meta.
+    (Import-light: only used by operators producing dumps — the
+    render path below never imports cess_tpu.)"""
+    codec = engine.codec
+    schedules = []
+    applies = [getattr(codec, "_parity_apply", None)]
+    applies += list(getattr(codec, "_cache", {}).values())
+    seen = set()
+    for ap in applies:
+        sched = getattr(ap, "_sched", None)
+        if sched is not None and sched.matrix_sha256 not in seen:
+            seen.add(sched.matrix_sha256)
+            schedules.append(sched.dump())
+    programs = []
+    cache = getattr(engine.programs, "_programs", None) or {}
+    for key in cache:
+        meta = {c[0]: c[1] for c in key
+                if isinstance(c, tuple) and len(c) == 2
+                and isinstance(c[0], str)}
+        if "strategy" not in meta:
+            continue
+        programs.append({
+            "key": [repr(c) for c in key],
+            "strategy": meta["strategy"],
+            "forced": not meta["strategy"].startswith("auto:"),
+            "dense_cost": meta.get("dense_cost"),
+            "xor_cost": meta.get("xor_cost"),
+            "n_xors": meta.get("n_xors"),
+        })
+    return {"kind": "xor_schedule_dump", "schedules": schedules,
+            "programs": programs}
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "xor_schedule_dump":
+        raise SystemExit(f"{path}: not an xor_schedule_dump payload")
+    return payload
+
+
+def _render_schedules(dump: dict, out) -> None:
+    scheds = dump.get("schedules", [])
+    print(f"compiled schedules ({len(scheds)}):", file=out)
+    for s in scheds:
+        r, q = s["r8"] // 8, s["q8"] // 8
+        counts = s.get("op_counts", {})
+        mix = " ".join(f"{k}={counts[k]}" for k in sorted(counts)
+                       if counts[k])
+        print(f"  [{r}x{q}] ({s['r8']}x{s['q8']} bits)  "
+              f"xors {s['dense_xors']} dense -> {s['n_xors']} "
+              f"scheduled  saving {100 * s['saving_frac']:.1f}%  "
+              f"scratch high-water {s['scratch_high_water']}", file=out)
+        print(f"    ops: {s.get('total_ops')} total ({mix})  "
+              f"matrix {s.get('matrix_sha256', '')[:12]}", file=out)
+
+
+def _render_programs(dump: dict, out) -> None:
+    progs = dump.get("programs", [])
+    print(f"cached programs ({len(progs)}):", file=out)
+    for p in progs:
+        head = " ".join(c for c in p.get("key", [])
+                        if not c.startswith("("))
+        mode = "forced" if p.get("forced") else "cost-model"
+        cost = ""
+        if p.get("dense_cost") is not None:
+            cost = (f"  dense={p['dense_cost']} xor={p['xor_cost']} "
+                    f"(n_xors={p['n_xors']})")
+        print(f"  {head:<28} strategy={p['strategy']:<12} "
+              f"[{mode}]{cost}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render an xor_schedule_dump payload (compiled "
+                    "XOR schedules + cached-program strategy "
+                    "attribution) as a human-readable report")
+    ap.add_argument("path", help="dump JSON (xor_schedule_dump payload)")
+    args = ap.parse_args(argv)
+    dump = _load(args.path)
+    n_forced = sum(1 for p in dump.get("programs", []) if p.get("forced"))
+    print(f"xor-schedule dump: {len(dump.get('schedules', []))} "
+          f"schedule(s), {len(dump.get('programs', []))} cached "
+          f"program(s) ({n_forced} forced)", file=sys.stdout)
+    _render_schedules(dump, sys.stdout)
+    _render_programs(dump, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
