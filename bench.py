@@ -1036,6 +1036,58 @@ def bench_chainwatch(n_nodes: int, rounds: int = 5):
     }
 
 
+def bench_custody(n_miners: int, segments: int = 128, rounds: int = 5):
+    """custody_scan_100node_ms: wall ms to close ONE custody
+    observation round at fleet scale — fold every segment's erasure
+    margin over the ledger view + holder liveness and run the
+    at-risk/lost detectors (cess_tpu/obs/custody). The ledger is
+    synthesized deterministically through the real record_* seams (no
+    node stack in the loop): ``segments`` RS(4, 4) segments spread
+    round-robin over ``n_miners`` holders, three of them dead, so one
+    decayed segment sits at margin 1 — the at-risk detector holds a
+    real edge through every timed round and ``durability_margin_min``
+    reports the floor the fold derives. One warm round runs outside
+    the timed window; the number decides how often a live author loop
+    can afford the margin fold."""
+    from cess_tpu.obs.custody import CustodyPlane
+
+    k, m = 4, 4
+    plane = CustodyPlane("bench", fragment_cap=segments * (k + m))
+    for s in range(segments):
+        file_hex = f"{s:064x}"
+        frags = tuple(f"{s:060x}{r:04x}" for r in range(k + m))
+        plane.ledger.record_dispatch("bench", file_hex, k, m,
+                                     [(f"{s:063x}f", frags)])
+        for r, fh in enumerate(frags):
+            # segment 0 concentrates on the three dead miners (m0-m2
+            # hold rows 0-2: margin 1); the rest spread round-robin
+            miner = f"m{(r if s == 0 else s * (k + m) + r) % n_miners}"
+            plane.ledger.record_transfer(miner, file_hex, r, (fh,))
+            plane.ledger.record_verdict(miner, s, True, True, (fh,))
+    alive = {f"m{j}": j >= 3 for j in range(n_miners)}
+
+    def one_round(rnd):
+        plane.observe_alive(alive)
+        plane.observe_restorals(())
+        plane.seal_round()
+
+    one_round(0)                           # warm
+    t0 = time.perf_counter()
+    for rnd in range(1, rounds + 1):
+        one_round(rnd)
+    wall_ms = (time.perf_counter() - t0) * 1e3 / rounds
+    margins = plane.margins()
+    snap = plane.snapshot()
+    return wall_ms, {
+        "n_miners": n_miners,
+        "segments": len(margins),
+        "rounds": rounds,
+        "margin_min": min(margins.values()),
+        "at_risk": len(snap["at_risk"]),
+        "lost": len(snap["lost"]),
+    }
+
+
 def main() -> None:
     global _ASSERT_FINITE
 
@@ -1054,11 +1106,12 @@ def main() -> None:
                     help="comma list: decode,speedup,repair,podr2,"
                          "pool,stream,degraded,traceov,adaptive,"
                          "encode,xor,sim,fleet,profile,chainwatch,"
-                         "remediate,lint")
+                         "remediate,custody,lint")
     args = ap.parse_args()
     known = {"decode", "speedup", "repair", "podr2", "pool", "stream",
              "degraded", "traceov", "adaptive", "encode", "xor", "sim",
-             "fleet", "profile", "chainwatch", "remediate", "lint"}
+             "fleet", "profile", "chainwatch", "remediate", "custody",
+             "lint"}
     which = set(args.metrics.split(",")) if args.metrics != "all" else known
     if which - known:
         raise SystemExit(f"unknown metrics: {sorted(which - known)}; "
@@ -1522,6 +1575,36 @@ def main() -> None:
                     "doubles, spike/stall/deep-reorg detectors, "
                     "cess_tpu/obs/chainwatch); states built outside "
                     "the timed window; lower is better")
+
+    if "custody" in which:
+        # host-only python like the chainwatch metric: the 100-miner
+        # shape runs under --smoke so the gate exercises the exact
+        # margin fold the durability plane runs live (ISSUE 20)
+        from cess_tpu.obs.custody import AT_RISK_MARGIN
+
+        wall_ms, extra = bench_custody(100)
+        # vs_baseline: against one 6 s block interval — how many
+        # times per block the author loop could afford the fold
+        emit("custody_scan_100node_ms", wall_ms, "ms",
+             BLOCK_MS / wall_ms, **extra,
+             method="wall ms to close one custody observation round "
+                    "over 128 synthesized RS(4,4) segments spread "
+                    "across 100 miners (erasure-margin fold over the "
+                    "ledger view + holder liveness, at-risk/lost "
+                    "detectors, cess_tpu/obs/custody); ledger built "
+                    "outside the timed window; lower is better")
+        # vs_baseline: margin floor against the at-risk threshold —
+        # the synthesized decayed segment pins it AT the threshold,
+        # so the fold regressing (losing healthy fragments it should
+        # count) or the decay vanishing both move the number
+        emit("durability_margin_min", float(extra["margin_min"]),
+             "fragments", extra["margin_min"] / AT_RISK_MARGIN,
+             n_miners=extra["n_miners"], segments=extra["segments"],
+             at_risk=extra["at_risk"], lost=extra["lost"],
+             method="minimum erasure margin (healthy fragments above "
+                    "k) the custody fold derives over the synthesized "
+                    "100-miner ledger, whose decayed segment sits at "
+                    "margin 1 by construction; higher is better")
 
     if "lint" in which:
         # host-only python like the sim metric: the full scan runs

@@ -46,8 +46,8 @@ CARRIER_RE = re.compile(r"^_[A-Z][A-Z0-9_]*$")
 #: optional plane attributes consumers guard with ``x = self.<attr>``
 SEAM_ATTRS = frozenset({
     "slo", "adaptive", "profile", "tracer", "recorder", "flight",
-    "fleet", "chainwatch", "remediation", "watch", "admission",
-    "resilience", "plan",
+    "fleet", "chainwatch", "remediation", "custody", "watch",
+    "admission", "resilience", "plan",
 })
 #: (path suffix, function) pairs that MUST carry the guard — the
 #: hooks every subsystem calls unconditionally on hot paths

@@ -64,14 +64,16 @@ class _SimRule(Rule):
         # count only, never a clock read or an entropy draw
         if "serve" in parts and parts[-1] == "remediate.py":
             return True
-        # the retention layer, the fleet plane, the profile plane and
-        # the chain plane make seeded decisions under the same replay
-        # contract as sim worlds
+        # the retention layer, the fleet plane, the profile plane,
+        # the chain plane and the custody plane make seeded decisions
+        # under the same replay contract as sim worlds (the custody
+        # ledger log + margin fold is the eighth witness stream)
         return "obs" in parts and parts[-1] in ("flight.py",
                                                 "incident.py",
                                                 "fleet.py",
                                                 "profile.py",
-                                                "chainwatch.py")
+                                                "chainwatch.py",
+                                                "custody.py")
 
 
 @register

@@ -188,6 +188,23 @@ def main(argv=None) -> int:
                          "(render with tools/remediation_view.py). "
                          "Requires --flight; absent = zero-cost off "
                          "(the --trace contract)")
+    ap.add_argument("--custody", action="store_true",
+                    help="arm the durability plane "
+                         "(cess_tpu/obs/custody.py) on this node: a "
+                         "bounded per-segment custody ledger fed by "
+                         "the --flight recorder's lineage notes "
+                         "(gateway dispatch, fragment transfer, TEE "
+                         "audit verdict, repair completion), folded "
+                         "into live erasure margins every few slots "
+                         "with edge-triggered custody.at_risk / "
+                         "custody.lost announcements. With "
+                         "--remediate the at-risk edge drives the "
+                         "proactive-repair policy. Served via the "
+                         "cess_custodyStatus RPC and cess_custody_* "
+                         "gauges on GET /metrics (render with "
+                         "tools/custody_view.py). Requires --flight; "
+                         "absent = zero-cost off (the --trace "
+                         "contract)")
     ap.add_argument("--slo", nargs="?", const="", default=None,
                     metavar="TARGETS",
                     help="attach an SLO board (cess_tpu/obs/slo.py) to "
@@ -406,8 +423,11 @@ def main(argv=None) -> int:
         nodes[0].incidents = reporter  # cess_incidentDump RPC surface
     plane = _arm_cli_fleet(args, nodes[0], reporter)
     watch = _arm_cli_chainwatch(args, nodes[0], reporter, plane)
+    custody = _arm_cli_custody(args, nodes[0], recorder, reporter)
     remediation = _arm_cli_remediate(args, nodes[0], recorder,
                                      reporter, engine)
+    if remediation is not None and custody is not None:
+        remediation.bind_custody(custody)  # proactive-repair targets
     rpc = None
     import threading
 
@@ -442,6 +462,13 @@ def main(argv=None) -> int:
             if plane is not None and slot % 4 == 0:
                 with chain_lock:
                     plane.tick()
+            # the custody margin fold seals after the scans above so
+            # the MarketWatch cross-check reads this slot's market
+            # view; its at-risk/lost edges land in the remediation
+            # plane's SAME decision round below
+            if custody is not None and slot % 4 == 0:
+                with chain_lock:
+                    _cli_custody_scrape(nodes[0], watch, custody)
             # the remediation plane decides AFTER the detectors'
             # scan/tick above: edges they announced this slot land as
             # actions in the same decision round. Actions may submit
@@ -460,6 +487,7 @@ def main(argv=None) -> int:
             engine.close()
         _finish_cli_profile(engine)
         _finish_cli_remediate(remediation)
+        _finish_cli_custody(custody)
         _finish_cli_chainwatch(watch)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)
@@ -695,6 +723,62 @@ def _finish_cli_remediate(plane) -> None:
           f"{c['flaps']} flap(s); {engaged}", file=sys.stderr)
 
 
+def _arm_cli_custody(args, node, recorder, reporter):
+    """--custody: arm a CustodyPlane (obs/custody.py) as
+    ``node.custody``: its ledger subscribes to the --flight
+    recorder's ("custody", ...) lineage notes, and the author/main
+    loop seals one margin-fold round every few slots (scraping the
+    open restoral-order set from the node's own runtime state, and
+    cross-checking the --chainwatch MarketWatch when one rides).
+    Returns the plane or None."""
+    if not getattr(args, "custody", False):
+        return None
+    if recorder is None:
+        print("--custody requires --flight (the custody ledger "
+              "subscribes to the flight recorder's lineage notes)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    from ..obs.custody import CustodyPlane
+
+    plane = CustodyPlane(node.name)
+    recorder.add_listener(plane.on_note)
+    if reporter is not None:
+        reporter.custody = plane  # bundles embed custody timelines
+    node.custody = plane
+    return plane
+
+
+def _cli_custody_scrape(node, watch, custody) -> None:
+    """One self-only custody round on a live node: the open
+    restoral-order set from the (replicated) runtime state, the
+    MarketWatch cross-check when a --chainwatch rides, then the seal
+    folds margins and runs the at-risk/lost detectors. Holder
+    liveness stays at the plane's default (alive) — a single node
+    has no fleet view to grade peers by."""
+    custody.observe_restorals(tuple(
+        frag for (frag,), _o in sorted(
+            node.runtime.state.iter_prefix("file_bank", "restoral"))))
+    if watch is not None:
+        custody.cross_check_market(watch.market.snapshot())
+    custody.seal_round()
+
+
+def _finish_cli_custody(custody) -> None:
+    """Print the custody summary: ledger sizes, the margin histogram
+    and what is at risk (render the full cess_custodyStatus payload
+    with tools/custody_view.py)."""
+    if custody is None:
+        return
+    snap = custody.snapshot()
+    sizes = snap["ledger"]
+    at_risk = ", ".join(snap["at_risk"]) or "nothing at risk"
+    print(f"custody plane: {snap['rounds']} round(s), "
+          f"{sizes['segments']} segment(s), "
+          f"{sizes['fragments']} fragment(s), "
+          f"{sizes['events_total']} ledger event(s), "
+          f"margins {snap['histogram']}; {at_risk}", file=sys.stderr)
+
+
 def _finish_cli_profile(engine) -> None:
     """Print the profile-plane summary: observation/pad/compile
     totals and the watchdog verdict (render the full cess_profileDump
@@ -902,8 +986,11 @@ def _run_tcp_node(args, spec) -> int:
         node.incidents = reporter     # cess_incidentDump RPC surface
     plane = _arm_cli_fleet(args, node, reporter)
     watch = _arm_cli_chainwatch(args, node, reporter, plane)
+    custody = _arm_cli_custody(args, node, recorder, reporter)
     remediation = _arm_cli_remediate(args, node, recorder, reporter,
                                      engine)
+    if remediation is not None and custody is not None:
+        remediation.bind_custody(custody)  # proactive-repair targets
     svc = NodeService(node, args.port, peers, slot_time=args.slot_time,
                       genesis_time=args.genesis_time)
     rpc = None
@@ -925,6 +1012,12 @@ def _run_tcp_node(args, spec) -> int:
                 print(f"#{head.number} author={head.author} "
                       f"finalized=#{fin} peers={len(svc._known_peers)}",
                       file=sys.stderr)
+            # the custody margin fold seals once per monitor
+            # iteration, BEFORE the remediation decision below, so
+            # an at-risk edge is acted on in the same pass
+            if custody is not None:
+                with svc.lock:
+                    _cli_custody_scrape(node, watch, custody)
             # one remediation decision round per monitor iteration:
             # edges the service's detector scans announced since the
             # last pass become actions here. Extrinsic-filing actions
@@ -944,6 +1037,7 @@ def _run_tcp_node(args, spec) -> int:
             engine.close()
         _finish_cli_profile(engine)
         _finish_cli_remediate(remediation)
+        _finish_cli_custody(custody)
         _finish_cli_chainwatch(watch)
         _finish_cli_fleet(plane, tracer)
         _finish_cli_flight(args, recorder, reporter)

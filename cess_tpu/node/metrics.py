@@ -82,6 +82,12 @@ def collect(node) -> dict[str, float]:
     remediation = getattr(node, "remediation", None)
     if remediation is not None:
         m.update(remediation.metrics())
+    # durability-plane gauges (obs/custody.py): ledger sizes, the
+    # erasure-margin minimum + histogram, at-risk/lost counts when a
+    # CustodyPlane is armed (node.cli --custody)
+    custody = getattr(node, "custody", None)
+    if custody is not None:
+        m.update(custody.metrics())
     return m
 
 

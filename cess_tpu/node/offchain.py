@@ -117,6 +117,12 @@ class OssGateway:
             self.node.submit_extrinsic(
                 self.account, "file_bank.upload_declaration", file_hash,
                 seg_list, UserBrief(owner, file_name, bucket), len(data))
+            # custody lineage: one encode+dispatch event per upload —
+            # the declared seg_list is exactly what the ledger needs
+            # (the guarded note is free when no recorder is armed)
+            _flight.note("custody", "dispatch", owner=owner,
+                         file=file_hash, k=cfg.k, m=cfg.m,
+                         segments=seg_list)
             return file_hash
 
 
@@ -351,6 +357,12 @@ class MinerAgent:
                 node.submit_extrinsic(self.account,
                                       "file_bank.transfer_report", fh)
                 self._reported.add(fh)
+                # custody transfer: this miner now holds its row of
+                # every segment (the ledger flips gateway -> miner)
+                _flight.note("custody", "transfer", miner=self.account,
+                             file=fh, row=row,
+                             frags=tuple(seg.fragment_hashes[row]
+                                         for seg in deal.segments))
         # answer challenges over REAL stored bytes
         ch = rt.audit.challenge()
         if ch is not None and not ch.cleared \
@@ -537,6 +549,7 @@ class MinerAgent:
         present = tuple(holders)
         mode = self.repair_mode
         via_symbols = False
+        ingress0 = self.repair_ingress_bytes
         with trace.span("offchain.repair", sys="offchain",
                         miner=self.account, row=row,
                         survivors=len(present), mode=mode):
@@ -579,6 +592,12 @@ class MinerAgent:
         self.node.submit_extrinsic(self.account,
                                    "file_bank.restoral_order_complete",
                                    frag_hash)
+        # custody restoral: the fragment's custodian is this miner now
+        # (the ledger clears the loss and re-scores the margin)
+        _flight.note("custody", "repair", miner=self.account,
+                     frag=frag_hash,
+                     mode="symbols" if via_symbols else "fragments",
+                     ingress=self.repair_ingress_bytes - ingress0)
         return True
 
 
@@ -791,6 +810,11 @@ class TeeAgent:
                                       "audit.submit_verify_result",
                                       mission.miner, idle_ok, service_ok,
                                       bls_sig)
+                # custody verdict: the frozen owed set is exactly the
+                # fragment list the audit outcome covers
+                _flight.note("custody", "verdict", miner=mission.miner,
+                             round=ch.start, service=service_ok,
+                             idle=idle_ok, frags=snap.service_frags)
 
     def _verify(self, blob, owed: list[bytes], seed: bytes,
                 idx, nu) -> bool:

@@ -51,6 +51,10 @@ Methods:
                       engagements, detector-health evidence and the
                       action journal; serve/remediate.py, armed via
                       node.cli --remediate)
+  cess_custodyStatus (durability plane: per-segment custody lineage,
+                      erasure margins + histogram, at-risk/lost
+                      lists and per-fragment timelines;
+                      obs/custody.py, armed via node.cli --custody)
   eth_* read subset + eth_sendRawTransaction + the EthFilter namespace
   (eth_newFilter / eth_newBlockFilter / eth_getFilterChanges /
   eth_getFilterLogs / eth_uninstallFilter) — polling filters with
@@ -388,6 +392,14 @@ class RpcServer:
             # the action journal. Null when the node runs without a
             # remediation plane (node.cli --remediate).
             plane = getattr(node, "remediation", None)
+            return None if plane is None else plane.snapshot()
+        if method == "cess_custodyStatus":
+            # durability plane (obs/custody.py): per-segment custody
+            # lineage timelines, the erasure-margin fold + histogram,
+            # the at-risk/lost lists and the anomaly transition log.
+            # Null when the node runs without a custody plane
+            # (node.cli --custody).
+            plane = getattr(node, "custody", None)
             return None if plane is None else plane.snapshot()
         if method == "cess_sloStatus":
             # SLO observability debug surface (obs/slo.py): per-class

@@ -34,6 +34,10 @@ trigger class       journal entry (subsystem, kind)
                     cooldown window (serve/remediate.py): the control
                     loop is oscillating, so it files its own
                     postmortem instead of churning silently
+``custody-at-``     ``("custody", "at_risk"|"lost")`` with ``to ==
+``risk`` /          "bad"`` — the durability plane's erasure-margin
+``custody-lost``    detector (obs/custody.py); the bundle embeds the
+                    segment's full per-fragment custody timeline
 ==================  ========================================================
 
 Each bundle is self-contained: the pinned traces, the journal tail,
@@ -72,6 +76,7 @@ from .trace import _json_safe
 # journal reacts to host-timed p99 estimates, so it is evidence, not
 # witness)
 _CANON_SYS = frozenset(("slo", "breaker", "engine", "stream", "sim",
+                        "custody",
                         "finality", "flight", "fleet", "perf", "chain",
                         "repair", "remediation"))
 
@@ -130,6 +135,11 @@ class IncidentReporter:
                    (policy table, engagements, the action journal
                    tail): what the autopilot was doing at trigger
                    time.
+    custody:       optional obs/custody.py CustodyPlane — bundles gain
+                   a ``custody`` snapshot section (margins, histogram,
+                   detector state), and a custody-triggered bundle
+                   embeds the at-risk segment's full per-fragment
+                   timeline.
     context:       optional callable returning a dict merged into each
                    bundle — sim runs supply the scenario seed +
                    witness needed to replay the episode.
@@ -144,7 +154,7 @@ class IncidentReporter:
 
     def __init__(self, recorder, *, engine=None, board=None, plan=None,
                  stitcher=None, profile=None, chainwatch=None,
-                 remediation=None, context=None,
+                 remediation=None, custody=None, context=None,
                  max_per_class: int = 4,
                  max_bundles: int = 32, shed_storm: int = 8,
                  repair_degraded: int = 8,
@@ -162,6 +172,7 @@ class IncidentReporter:
             else getattr(engine, "profile", None)
         self.chainwatch = chainwatch
         self.remediation = remediation
+        self.custody = custody
         self.context = context
         self.max_per_class = max_per_class
         self.shed_storm = shed_storm
@@ -245,6 +256,14 @@ class IncidentReporter:
                          key=f"{detail.get('policy')}:"
                              f"{detail.get('key')}",
                          detail=detail)
+        elif subsystem == "custody" and kind in ("at_risk", "lost"):
+            # the durability detector announces edge-triggered both
+            # ways; only the ok->bad edge is an incident
+            if detail.get("to") != "bad":
+                return
+            self.trigger("custody-at-risk" if kind == "at_risk"
+                         else "custody-lost",
+                         key=str(detail.get("key")), detail=detail)
         elif subsystem == "chain" and kind == "anomaly":
             # edge-triggered both ways by the detector; only the
             # ok->bad edge is an incident, and the detail's cls must
@@ -331,6 +350,19 @@ class IncidentReporter:
             snap = remediation.snapshot()
             snap["journal"] = snap["journal"][-self.journal_tail:]
             snapshots["remediation"] = snap
+        custody = self.custody
+        if custody is not None:
+            # the durability truth source rides every bundle (margins,
+            # histogram, detector state; timelines stay out — they are
+            # per-segment evidence), and a custody trigger embeds the
+            # at-risk segment's FULL per-fragment timeline: fragment
+            # F's whole history from dispatch to the edge
+            snap = custody.snapshot()
+            snap.pop("timelines", None)
+            snapshots["custody"] = snap
+            if cls.startswith("custody-"):
+                snapshots["custody_timeline"] = \
+                    custody.segment_timeline(key)
         stitcher = self.stitcher
         stitched = [] if stitcher is None else stitcher.traces()
         with self._mu:

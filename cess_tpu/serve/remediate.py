@@ -63,7 +63,7 @@ __all__ = ["Policy", "RemediationPlane", "default_policies"]
 # action verbs a Policy row may name; engage/disengage semantics live
 # in RemediationPlane._apply
 ACTIONS = ("pin-reference", "quarantine-lane", "file-offence",
-           "flip-repair-mode")
+           "flip-repair-mode", "proactive-repair")
 
 # one-shot actions complete at fire time (nothing to hold, nothing to
 # release); the rest stay "engaged" until their release condition
@@ -81,7 +81,8 @@ _EVIDENCE = frozenset((("slo", "transition"), ("breaker", "trip"),
                        ("breaker", "hold"), ("breaker", "release"),
                        ("breaker", "recover"), ("perf", "regression"),
                        ("chain", "anomaly"), ("fleet", "outlier"),
-                       ("repair", "fallback"), ("repair", "mode")))
+                       ("repair", "fallback"), ("repair", "mode"),
+                       ("custody", "at_risk"), ("custody", "lost")))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,6 +175,19 @@ def default_policies() -> tuple:
                trigger=("remediation", "ingress"), match=(),
                key_field="miner", action="flip-repair-mode",
                release_after=12, cooldown=6, max_fires=32),
+        # Custody at-risk edge (obs/custody.py): a segment's erasure
+        # margin fell to the detector threshold — proactively rebuild
+        # its unhealthy fragments through the regenerating symbol path
+        # (1.0 fragment-equivalents of ingress per rebuild) BEFORE the
+        # k-th fragment dies. Engaged until the margin-recovered edge
+        # releases it; each tick in between re-attempts the rebuild
+        # (the filed restoral order only applies one block later).
+        Policy(name="custody-repair", trigger=("custody", "at_risk"),
+               match=(("to", "bad"),), key_field="key",
+               action="proactive-repair",
+               release_on=("custody", "at_risk"),
+               release_match=(("to", "ok"),),
+               release_after=8, cooldown=2, max_fires=64),
     )
 
 
@@ -252,9 +266,11 @@ class RemediationPlane:
         self._released_at: dict[tuple, int] = {}    # (policy, key) -> tick
         self._health: dict[str, dict] = {"slo": {}, "breaker": {},
                                          "perf": {}, "chain": {},
-                                         "fleet": {}, "repair": {}}
+                                         "fleet": {}, "repair": {},
+                                         "custody": {}}
         self._engine = None
         self._node = None
+        self._custody = None
         self._miners: dict[str, Any] = {}
         self._intended_mode: dict[str, str] = {}
         self._ingress_last: dict[str, tuple] = {}
@@ -276,6 +292,13 @@ class RemediationPlane:
         surface the file-offence action uses."""
         with self._mu:
             self._node = node
+
+    def bind_custody(self, plane) -> None:
+        """Attach the custody plane (obs/custody.py) whose
+        at-risk-segment repair targets the proactive-repair action
+        rebuilds through the bound miners."""
+        with self._mu:
+            self._custody = plane
 
     def bind_miners(self, miners) -> None:
         """Attach the miner agents whose repair_mode the ingress
@@ -353,6 +376,9 @@ class RemediationPlane:
         elif subsystem == "repair":
             h[str(detail.get("miner", "?"))] = str(
                 detail.get("to", kind))
+        elif subsystem == "custody":
+            h[str(detail.get("key", "?"))] = \
+                f"{kind}:{detail.get('to', '?')}"
         while len(h) > 64:           # bounded: evict oldest insertion
             h.pop(next(iter(h)))
 
@@ -365,6 +391,7 @@ class RemediationPlane:
         entries this round."""
         todo: list = []
         notes: list = []
+        pumps: list = []
         with self._mu:
             self._count += 1
             self._sample_ingress_locked()
@@ -382,12 +409,24 @@ class RemediationPlane:
                         >= p.release_after:
                     self._decide_release_locked(pname, key, "re-probe", todo,
                                          notes)
+            # engagements that survived the release pass pump one
+            # rebuild attempt per tick: the fire-time attempt usually
+            # only FILES the restoral order (applied a block later),
+            # so the engagement retries until the margin-recovered
+            # edge releases it. Decisions are unaffected (no journal
+            # entry), so a dry run's witness stays byte-identical.
+            if not self.dry_run:
+                pumps = [key for (pname, key), eng
+                         in sorted(self._engaged.items())
+                         if eng["action"] == "proactive-repair"]
             entries = 0
             for pname, key, edge_id, detail in self._pending_fire:
                 self._decide_fire_locked(pname, key, edge_id, detail, todo,
                                   notes)
                 entries += 1
             self._pending_fire = []
+        for key in pumps:
+            self._proactive_repair(key)
         for kind, args in todo:
             ok = self._apply(kind, args)
             args[0]["applied"] = ok
@@ -514,6 +553,10 @@ class RemediationPlane:
             return self._file_offence(key)
         elif action == "flip-repair-mode":
             return self._flip_mode(key, engage)
+        elif action == "proactive-repair":
+            if not engage:
+                return True          # release: nothing held
+            return self._proactive_repair(key)
         else:
             return False
         for mon in mons:
@@ -598,6 +641,46 @@ class RemediationPlane:
                     return False
                 return True
         return False
+
+    def _proactive_repair(self, key: str) -> bool:
+        """Rebuild one at-risk segment's unhealthy fragments through
+        the existing MinerAgent repair seams. For a silently-dead
+        custodian (nobody filed the loss) the plane files the restoral
+        order itself — it applies one block later, so the engagement's
+        per-tick pump finishes the rebuild next round. Rescuers run
+        the regenerating symbol chain: 1.0 fragment-equivalents of
+        ingress per rebuilt fragment."""
+        with self._mu:
+            plane = self._custody
+            node = self._node
+            miners = [self._miners[a] for a in sorted(self._miners)]
+        if plane is None or node is None or not miners:
+            return False
+        rt = node.runtime
+        progressed = False
+        for tgt in plane.repair_targets(key):
+            frag = bytes.fromhex(tgt["frag"])
+            holder = tgt["holder"]
+            if rt.file_bank.restoral_order(frag) is None:
+                if holder is not None:
+                    node.submit_extrinsic(
+                        holder, "file_bank.generate_restoral_order",
+                        bytes.fromhex(tgt["file"]), frag)
+                    progressed = True
+                continue
+            rescuer = next(
+                (m for m in miners
+                 if m.account != holder and frag not in m.store
+                 and plane.holder_alive(m.account)), None)
+            if rescuer is None:
+                continue
+            if rescuer.repair_mode != "symbols":
+                rescuer.set_repair_mode("symbols")
+                with self._mu:
+                    self._intended_mode[rescuer.account] = "symbols"
+            if rescuer.try_repair(frag, miners):
+                progressed = True
+        return progressed
 
     def _flip_mode(self, key: str, engage: bool) -> bool:
         miner = self._miners.get(key)

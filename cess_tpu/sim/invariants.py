@@ -34,6 +34,18 @@ matched has a journaled fire/suppress decision by an enabled policy)
 and ``remediation-effective`` (every engagement is visibly latched on
 its seam, and a still-regressed perf metric is never left without an
 active or cooldown-fresh engagement).
+
+The ``custody`` family (ISSUE 20) judges the durability plane:
+``custody-ledger-consistent`` (every sealed erasure margin the
+custody plane folds from its lineage ledger must re-derive from RAW
+world storage — ledger holder identity checked against the holder's
+actual fragment bytes and node liveness — and every active on-chain
+file's segments must be in the ledger: a deleted byte nobody noted,
+or a segment the ledger never saw, breaks it) and
+``custody-proactive`` (while the remediation plane rides, no segment
+may ever cross below k healthy fragments — the at-risk edge plus the
+proactive-repair policy must hold the margin — and every active
+at-risk key must have reached the remediation plane's evidence map).
 """
 from __future__ import annotations
 
@@ -426,6 +438,100 @@ def check_remediation_effective(world) -> list[str]:
     return out
 
 
+def check_custody_ledger_consistent(world) -> list[str]:
+    """ISSUE 20: the custody plane's erasure-margin fold must agree
+    with a raw re-derivation from world storage. The plane's side is
+    :meth:`~cess_tpu.obs.custody.CustodyPlane.fold_margins` — the
+    LIVE fold over the ledger view (sealed margins go stale the
+    moment the remediation tick repairs something between seal and
+    check). The raw side replaces only the step the ledger cannot see
+    from notes: a fragment counts healthy iff its ledger holder
+    actually HOLDS matching bytes on an alive node (gateway custody —
+    no holder yet — counts healthy on both sides). Deleting a miner's
+    bytes behind the seams' back makes the two sides disagree. The
+    coverage half: every active on-chain file's segments must be in
+    the ledger — an upload the dispatch seam never noted is lineage
+    lost before it started."""
+    plane = getattr(world, "custody", None)
+    if plane is None:
+        return []
+    out = []
+    view = plane.ledger.view()
+    folded = plane.fold_margins()
+    for key in sorted(view["segments"]):
+        seg = view["segments"][key]
+        raw_good = 0
+        for fh in seg["frags"]:
+            if fh in view["lost"]:
+                continue
+            holder = view["holder"].get(fh)
+            if holder is None:
+                raw_good += 1        # still gateway custody
+                continue
+            home = world.role_homes.get(holder)
+            if home is not None and not world.alive[home]:
+                continue
+            agent = world.agents.get(holder)
+            blob = None if agent is None \
+                else agent.store.get(bytes.fromhex(fh))
+            if blob is None or fragment_hash(blob) != bytes.fromhex(fh):
+                continue
+            v = view["verdicts"].get(holder)
+            if v is not None and not v["service"]:
+                continue
+            raw_good += 1
+        raw_margin = raw_good - seg["k"]
+        if folded.get(key) != raw_margin:
+            out.append(
+                f"custody-ledger-consistent: segment {key} folds "
+                f"margin {folded.get(key)} from the ledger but raw "
+                f"world storage derives {raw_margin}")
+    alive = [i for i in range(world.n) if world.alive[i]]
+    if alive:
+        st = world.nodes[alive[0]].runtime.state
+        for (fh,), f in sorted(st.iter_prefix("file_bank", "file")):
+            if f.state != "active":
+                continue
+            for idx in range(len(f.segments)):
+                key = f"{fh.hex()}:{idx}"
+                if key not in view["segments"]:
+                    out.append(
+                        f"custody-ledger-consistent: active segment "
+                        f"{key} is on chain but absent from the "
+                        f"custody ledger")
+    return out
+
+
+def check_custody_proactive(world) -> list[str]:
+    """ISSUE 20: the point of the durability plane — while the
+    remediation plane rides, proactive repair must hold every erasure
+    margin, so a ``lost`` edge (margin < 0: some fragment set crossed
+    below k) is the drill failing by definition. Fires on a world
+    where the custody-repair policy was disabled behind the plane's
+    back (at-risk decays to lost with nobody rebuilding). The second
+    half catches an unplugged listener: every ACTIVE at-risk key must
+    have reached the remediation plane's custody evidence map."""
+    plane = getattr(world, "custody", None)
+    rem = getattr(world, "remediation", None)
+    if plane is None or rem is None:
+        return []
+    out = []
+    for (_seq, cls, key, _old, to) in plane.detector.transition_log():
+        if cls == "lost" and to == "bad":
+            out.append(
+                f"custody-proactive: segment {key} crossed below k "
+                f"healthy fragments while the remediation plane was "
+                f"armed — proactive repair failed to hold the margin")
+    evidence = rem.snapshot()["health"].get("custody", {})
+    for key in plane.detector.active().get("at_risk", ()):
+        if key not in evidence:
+            out.append(
+                f"custody-proactive: at-risk segment {key} never "
+                f"reached the remediation plane's evidence map — the "
+                f"custody listener is unplugged")
+    return out
+
+
 CHECKERS = {
     "finalized-prefix": check_finalized_prefix,
     "vote-locks": check_vote_locks,
@@ -439,6 +545,8 @@ CHECKERS = {
     "fleet-consistency": check_fleet_consistency,
     "remediation-coverage": check_remediation_coverage,
     "remediation-effective": check_remediation_effective,
+    "custody-ledger-consistent": check_custody_ledger_consistent,
+    "custody-proactive": check_custody_proactive,
 }
 
 

@@ -94,6 +94,20 @@ class Scenario:
       the ``remediation-*`` checkers see post-decision state). Its
       action-journal witness joins :meth:`SimReport.witness` as the
       seventh stream.
+    - ``custody``: arm a
+      :class:`~cess_tpu.obs.custody.CustodyPlane` as ``world.custody``:
+      its ledger fills continuously from the run recorder's
+      ``("custody", ...)`` lineage notes (gateway dispatch, miner
+      transfer, TEE verdict, repair completion), and one
+      :func:`_custody_scrape` per virtual round feeds holder
+      liveness + the open restoral-order set, cross-checks the
+      MarketWatch when ``chainwatch`` rides too, and seals the
+      erasure-margin fold (the at-risk/lost detector edges land in
+      the armed incident reporter — the bundle embeds the segment's
+      full custody timeline). With ``remediate`` the plane also
+      binds as the remediation plane's repair-target feed
+      (``bind_custody``), closing the proactive-repair loop. Its
+      witness joins :meth:`SimReport.witness` as the eighth stream.
     """
 
     name: str
@@ -115,6 +129,7 @@ class Scenario:
     # (ops/regen.py, rs_backend="regen") so storm_repair rescuers run
     # symbol-mode repairs and the fold programs ride the lane caches
     regen: bool = False
+    custody: bool = False
 
 
 def resolve_ref(world: World, ref: str) -> int:
@@ -201,6 +216,12 @@ class SimReport:
     # witness (same seed => byte-identical action log) IS part of the
     # replay contract, as the seventh witness stream
     remediation: "object | None" = None
+    # the custody/durability plane (ISSUE 20): the run's CustodyPlane
+    # when the scenario ran ``custody=True`` — its witness (flat
+    # count-sequenced ledger log + sealed margins + detector
+    # transitions) IS part of the replay contract, as the eighth
+    # witness stream
+    custody: "object | None" = None
 
     def witness(self) -> tuple:
         """Everything that must be bit-identical across two same-seed
@@ -213,7 +234,9 @@ class SimReport:
                 self.chainwatch.witness()
                 if self.chainwatch is not None else b"",
                 self.remediation.witness()
-                if self.remediation is not None else b"")
+                if self.remediation is not None else b"",
+                self.custody.witness()
+                if self.custody is not None else b"")
 
 
 def _build_world(scenario: Scenario, seed, n_nodes: int | None) -> World:
@@ -363,6 +386,34 @@ def _apply_action(world: World, pending: dict, rnd: int,
                                       world.gateways):
                     repaired += 1
         world.queue.mark(f"repair_contend:{repaired}")
+    elif action == "attrition":
+        # one seeded SILENT miner death (the durability drill's slow
+        # attrition): the victim's fragments vanish and its home node
+        # crashes, but — unlike storm_kill — nobody files restoral
+        # orders. Detecting the decay is the custody plane's job (the
+        # margin fold over holder liveness), and the proactive-repair
+        # policy must file the orders itself. Victim drawn seeded from
+        # the first active file's still-alive assigned miners
+        rt = world.gateways[0].node.runtime
+        holders: list[str] = []
+        for (_fh,), f in sorted(rt.state.iter_prefix("file_bank", "file")):
+            if f.state != "active":
+                continue
+            holders = sorted(set(f.miners))
+            break
+        alive_holders = [a for a in holders
+                         if world.alive[world.role_homes[a]]]
+        if not alive_holders:
+            raise LookupError("attrition: no alive assigned miner "
+                              "to kill")
+        victim_acct = alive_holders[
+            world.u64("attrition", rnd) % len(alive_holders)]
+        victim = world.agents[victim_acct]
+        dropped = len(victim.store)
+        victim.store.clear()
+        victim.tags.clear()
+        world.crash(world.role_homes[victim_acct])
+        world.queue.mark(f"attrition:{victim_acct}:{dropped}")
     elif action == "equivocate":
         _equivocate(world, args[0])
     elif action == "perf_edge":
@@ -497,6 +548,31 @@ def _chainwatch_scrape(world: World, watch, rnd: int) -> None:
     watch.seal_round()
 
 
+def _custody_scrape(world: World, plane, rnd: int) -> None:
+    """One custody observation round (obs/custody.py). The ledger
+    itself fills continuously from the armed recorder's
+    ``("custody", ...)`` lineage notes — this helper feeds only the
+    per-round facts no seam carries: holder liveness from the world's
+    role homes, the open restoral-order set from the (replicated)
+    chain state of the lowest alive node, and the MarketWatch
+    cross-check when a chain watch rides the same run. The seal folds
+    the erasure margins and runs the at-risk/lost detectors, whose
+    edges land in the armed incident reporter."""
+    homes = getattr(world, "role_homes", {})
+    plane.observe_alive({acct: bool(world.alive[idx])
+                         for acct, idx in homes.items()})
+    alive = [i for i in range(world.n) if world.alive[i]]
+    if alive:
+        st = world.nodes[alive[0]].runtime.state
+        plane.observe_restorals(tuple(
+            frag for (frag,), _o
+            in sorted(st.iter_prefix("file_bank", "restoral"))))
+    watch = world.chainwatch
+    if watch is not None:
+        plane.cross_check_market(watch.market.snapshot())
+    plane.seal_round()
+
+
 def _pool_engine(world: World, profile: bool = False,
                  regen: bool = False, lanes=True):
     """A device-pool submission engine matched to the world's storage
@@ -560,6 +636,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
     fleet_plane = None
     chain_watch = None
     remediation = None
+    custody_plane = None
     stack = contextlib.ExitStack()
     try:
         with stack:
@@ -617,12 +694,23 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 if fleet_plane is not None:
                     chain_watch.attach_fleet(fleet_plane)
                 world.chainwatch = chain_watch
+            if scenario.custody:
+                # the custody/durability plane (obs/custody.py): armed
+                # as world.custody, its ledger fed by the recorder's
+                # ("custody", ...) lineage notes; one scrape + margin
+                # seal per virtual round (see _custody_scrape)
+                from ..obs.custody import CustodyPlane
+
+                custody_plane = CustodyPlane("sim")
+                recorder.add_listener(custody_plane.on_note)
+                world.custody = custody_plane
             if scenario.remediate:
                 # the remediation plane (serve/remediate.py): armed as
                 # world.remediation, fed by the run's flight recorder,
                 # acting through whatever seams the scenario built —
                 # the pool engine's breakers, the storage miners, the
-                # lowest node's extrinsic surface
+                # lowest node's extrinsic surface, the custody plane's
+                # repair targets
                 from ..serve.remediate import RemediationPlane
 
                 remediation = RemediationPlane(seed_b)
@@ -631,6 +719,8 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 remediation.bind_miners(
                     getattr(world, "miners", ()) or ())
                 remediation.bind_node(world.nodes[0])
+                if custody_plane is not None:
+                    remediation.bind_custody(custody_plane)
                 recorder.add_listener(remediation.on_note)
                 world.remediation = remediation
             # each bundle embeds the scenario identity + the live
@@ -642,6 +732,7 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                 profile=profile_plane,
                 chainwatch=chain_watch,
                 remediation=remediation,
+                custody=custody_plane,
                 context=lambda: {
                     "scenario": scenario.name,
                     "seed": seed_b.hex(),
@@ -674,6 +765,12 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                         _chainwatch_scrape(world, chain_watch, rnd)
                     if fleet_plane is not None:
                         _fleet_scrape(world, fleet_plane, rnd)
+                    if custody_plane is not None:
+                        # seal the margin fold BEFORE the remediation
+                        # tick: an at-risk edge decided this round is
+                        # acted on this round, and the custody-*
+                        # checkers judge post-decision state
+                        _custody_scrape(world, custody_plane, rnd)
                     if remediation is not None:
                         # decide + apply the round's detector edges
                         # BEFORE the checks: the remediation-*
@@ -704,7 +801,8 @@ def run_scenario(scenario: Scenario, seed, *, n_nodes: int | None = None,
                      uploads_active=active, recorder=recorder,
                      reporter=reporter, pool=pool_snap or None,
                      fleet=fleet_plane, profile=profile_snap or None,
-                     chainwatch=chain_watch, remediation=remediation)
+                     chainwatch=chain_watch, remediation=remediation,
+                     custody=custody_plane)
 
 
 # -- the library --------------------------------------------------------------
@@ -911,5 +1009,33 @@ SCENARIOS: dict[str, Scenario] = {
         ),
         checks=("finalized-prefix", "vote-locks"),
         final_checks=("restoral-single-winner", "storage-convergence"),
+    ),
+    # the durability drill (ISSUE 20): miners die SILENTLY, one at a
+    # time — no restoral order filed, no alarm raised by the dying
+    # side. The custody plane's ledger (fed by the dispatch/transfer/
+    # verdict/repair lineage notes) folds erasure margins over holder
+    # liveness every round: each death drops the first file's margin
+    # to the at-risk threshold, the `custody.at_risk` edge fires
+    # BEFORE any fragment set crosses below k, the remediation
+    # plane's custody-repair policy files the restoral order itself
+    # and pumps a symbol-mode rebuild until the margin-recovered edge
+    # releases it. custody-ledger-consistent re-derives every margin
+    # from raw world storage each round; custody-proactive fails the
+    # run if any segment ever crosses below k while the autopilot
+    # rides. Same seed => byte-identical custody witness at any n
+    "miner_attrition": Scenario(
+        name="miner_attrition", rounds=14, custody=True,
+        remediate=True,
+        world=(("n_validators", 5),
+               ("storage", (("n_miners", 6), ("k", 2), ("m", 2)))),
+        timeline=(
+            (1, "upload", 0, "alice", 16_000),
+            (5, "attrition",),
+            (9, "attrition",),
+        ),
+        checks=("finalized-prefix", "vote-locks",
+                "custody-ledger-consistent", "custody-proactive",
+                "remediation-coverage"),
+        final_checks=("storage-convergence",),
     ),
 }

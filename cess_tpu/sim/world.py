@@ -170,6 +170,9 @@ class World:
         # the chain-plane watch (obs/chainwatch.py): armed by
         # chainwatch=True scenarios under the same zero-cost contract
         self.chainwatch = None
+        # the custody/durability plane (obs/custody.py): armed by
+        # custody=True scenarios under the same zero-cost contract
+        self.custody = None
         if storage is not None:
             storage.install(self)
 

@@ -39,6 +39,8 @@ EXPECTED = (
     "cesslint_full_tree_s",
     "rs_xor_encode_GiBps_per_chip",
     "xor_schedule_saving_frac",
+    "custody_scan_100node_ms",
+    "durability_margin_min",
 )
 
 
@@ -197,6 +199,18 @@ def test_bench_smoke_every_metric_finite():
     assert lint["files"] > 50 and lint["rules"] >= 17
     assert lint["findings"] == 0 and lint["errors"] == 0
     assert lint["stale_suppressions"] == 0
+    # the durability pins (ISSUE 20): the custody margin fold at the
+    # same 100-node shape, with the detector counts riding along so a
+    # silently-empty ledger can't pass — and the synthesized decayed
+    # segment pins the margin floor AT the at-risk threshold (so the
+    # smoke gate's v > 0 holds and a fold that loses or invents
+    # healthy fragments moves the number)
+    cu = got["custody_scan_100node_ms"]
+    assert cu["n_miners"] == 100 and cu["segments"] >= 100
+    assert cu["margin_min"] == 1
+    assert cu["at_risk"] >= 1 and cu["lost"] == 0
+    dm = got["durability_margin_min"]
+    assert dm["value"] == 1.0 and dm["at_risk"] >= 1
     # EVERY record carries n_devices so tools/bench_diff.py can refuse
     # to cross-compare a per-chip row against a pool row
     for r in recs:
@@ -277,6 +291,17 @@ class TestBenchDiff:
         # and adding it flips no wall-clock name
         assert not bench_diff.lower_is_better("xor_schedule_saving_frac")
         assert bench_diff.lower_is_better("anything_else_ending_in_s")
+        # ISSUE 20 satellite: the erasure-margin floor regresses
+        # DOWNWARD (more healthy fragments above k = safer), the
+        # durability decay counts regress UPWARD — and neither rule
+        # swallows the existing suffix families
+        assert not bench_diff.lower_is_better("durability_margin_min")
+        assert bench_diff.lower_is_better("custody_scan_100node_ms")
+        assert bench_diff.lower_is_better("custody_segments_at_risk")
+        assert bench_diff.lower_is_better("custody_segments_lost")
+        assert not bench_diff.lower_is_better(
+            "podr2_100k_tag_verify_frags_per_s")
+        assert bench_diff.lower_is_better("repair_storm_drain_s")
 
     def test_default_against_is_the_next_lower_round(self, tmp_path,
                                                       monkeypatch):

@@ -320,9 +320,10 @@ class TestDisarmedIsFree:
         report = run_scenario(sc, b"cold", n_nodes=8)
         assert report.chainwatch is None
         w = report.witness()
-        # 7-tuple since the remediation plane joined the witness; both
-        # optional planes are empty-bytes when unarmed
-        assert len(w) == 7 and w[5] == b"" and w[6] == b""
+        # 8-tuple since the custody plane joined the witness; every
+        # optional plane is empty-bytes when unarmed
+        assert len(w) == 8 and w[5] == b"" and w[6] == b"" \
+            and w[7] == b""
 
 
 # -- the replay drill --------------------------------------------------------

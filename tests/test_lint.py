@@ -691,6 +691,39 @@ class TestSimDeterminism:
         assert lint(CLEAN_SIM,
                     "cess_tpu/obs/chainwatch.py").findings == []
 
+    def test_custody_plane_joins_the_family(self):
+        """ISSUE 20: the custody plane's ledger event log, margin
+        folds and detector transitions are the eighth replay witness
+        stream (same seed => byte-identical custody bytes), so
+        obs/custody.py joins the determinism family next to
+        chainwatch.py — and the clean twin stays silent."""
+        assert rules_at(
+            lint(DIRTY_SIM, "cess_tpu/obs/custody.py")) == \
+            {"sim-wallclock", "sim-entropy"}
+        assert lint(CLEAN_SIM,
+                    "cess_tpu/obs/custody.py").findings == []
+
+    def test_custody_module_scans_clean_under_every_family(self):
+        """ISSUE 20 satellite: the shipped obs/custody.py passes
+        trace-safety, lock-discipline, span-balance AND the sim
+        determinism family with zero suppressions (witness-purity,
+        race and seam-cost apply package-wide and cover it through
+        the full-tree scan); the dirty twins prove each family really
+        fires at that path, and the baseline stays empty."""
+        for dirty, rule in ((DIRTY_TRACE, "trace-print"),
+                            (DIRTY_LOCK, "lock-unguarded-write"),
+                            (DIRTY_SPAN, "span-balance"),
+                            (DIRTY_SIM, "sim-wallclock")):
+            assert rule in rules_at(
+                lint(dirty, "cess_tpu/obs/custody.py")), rule
+        r = analysis.lint_paths(
+            [os.path.join(REPO, "cess_tpu", "obs", "custody.py")],
+            root=REPO)
+        assert r.errors == []
+        assert [f.format() for f in r.findings] == []
+        assert r.suppressed == []
+        assert analysis.load_baseline(BASELINE) == {}
+
     def test_regen_repair_plane_joins_the_family(self):
         """ISSUE 15: the regenerating repair plane's coefficient and
         matrix constructions feed the repair storm's replay contract,

@@ -68,7 +68,8 @@ class TestPolicyTable:
         pols = {p.name: p for p in default_policies()}
         assert set(pols) == {"perf-pin", "breaker-pin",
                              "straggler-quarantine",
-                             "equivocation-report", "repair-ingress"}
+                             "equivocation-report", "repair-ingress",
+                             "custody-repair"}
         # every shipped action verb is exercised by some default row
         assert {p.action for p in pols.values()} == set(ACTIONS)
         # rows are JSON-shaped for the RPC snapshot
@@ -408,7 +409,7 @@ class TestWitnessReplay:
         assert snap["policies"] and snap["journal"]
         assert snap["health"]["perf"]["encode"] == "ok"
         m = plane.metrics()
-        assert m["cess_remediation_policies"] == 5
+        assert m["cess_remediation_policies"] == 6
         assert m["cess_remediation_fires_total"] >= 3
         assert m["cess_remediation_dry_run"] == 0
         assert all(k.startswith("cess_remediation_") for k in m)

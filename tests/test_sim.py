@@ -289,6 +289,18 @@ def _assert_scenario_behavior(name, report):
         assert report.uploads_active >= 1
     elif name == "partition_heal":
         assert max(f for f, _ in report.world.finalized_prefix()) > 0
+    elif name == "miner_attrition":
+        # ISSUE 20: both silent deaths fired the at-risk edge, the
+        # proactive rebuild released each one, and no fragment set
+        # ever crossed below k (the drill's whole point) — the deep
+        # assertions live in tests/test_custody.py
+        log = report.custody.detector.transition_log()
+        assert all(cls != "lost" for (_s, cls, _k, _o, _t) in log)
+        assert sum(1 for (_s, cls, _k, _o, to) in log
+                   if cls == "at_risk" and to == "bad") == 2
+        assert report.custody.detector.active() == {}
+        assert any(kind == "repair" for (_s, kind, _f, _d)
+                   in report.custody.ledger.log())
     elif name == "gateway_hotspot_fleet":
         # ISSUE 12: the stripe partition's head lag must be VISIBLE at
         # fleet level — both global views flipped to warn and recovered

@@ -94,6 +94,35 @@ class TestViewerSmoke:
         assert "perf-pin" in out
         assert "pin-reference" in out
 
+    def test_custody_view_renders_the_custody_status_fixture(
+            self, capsys):
+        # fixture dumped from one miner_attrition run (seed
+        # b"fixtures", 20 nodes): two silent-death -> proactive-repair
+        # episodes live in its timelines and transition log
+        mod = _viewer("custody_view")
+        assert mod.main([_fixture("custody_status.json")]) == 0
+        out = capsys.readouterr().out
+        assert "custody plane @" in out
+        assert "margin histogram (" in out
+        assert "at-risk (" in out
+        assert "segments (worst" in out
+        assert "fragment timelines (" in out
+        assert "anomaly transition log (" in out
+        # the drill's lineage is visible end-to-end: the silent death
+        # surfaced as a restoral, the proactive rebuild as a repair,
+        # and the at_risk edge both fired and released
+        assert "restoral" in out and "repair(" in out
+        assert "at_risk" in out and "ok -> bad" in out \
+            and "bad -> ok" in out
+
+    def test_custody_view_segment_table_is_capped(self, capsys):
+        mod = _viewer("custody_view")
+        assert mod.main([_fixture("custody_status.json"),
+                         "--segments", "1", "--timelines", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "segments (worst 1 of" in out
+        assert "fragment timelines (first 2 of" in out
+
     def test_xor_view_renders_the_schedule_dump_fixture(self, capsys):
         # fixture collected from real engines (strategy="auto" and a
         # forced strategy="xor") after encode + warm_repair +
@@ -136,6 +165,8 @@ class TestViewerSmoke:
                               ("incident_view", "profile_dump.json"),
                               ("remediation_view",
                                "chain_status.json"),
+                              ("custody_view",
+                               "remediation_status.json"),
                               ("xor_view", "profile_dump.json")):
             mod = _viewer(viewer)
             with pytest.raises(SystemExit):

@@ -108,13 +108,18 @@ def newest_rounds() -> list[str]:
 
 
 def lower_is_better(metric: str) -> bool:
-    # latencies (_ms), wall-clock drains (_s) and repair-cost ratios
-    # (_per_recovered_byte) regress UPWARD; rates (_per_s, _GiBps, _x)
-    # and schedule-compiler savings (_saving_frac: the CSE'd XOR
-    # reduction, bigger = fewer ops) regress downward — "_s" must not
-    # swallow throughput names like podr2_..._frags_per_s
-    if metric.endswith("_saving_frac"):
+    # latencies (_ms), wall-clock drains (_s), repair-cost ratios
+    # (_per_recovered_byte) and durability decay counts (_at_risk,
+    # _lost) regress UPWARD; rates (_per_s, _GiBps, _x),
+    # schedule-compiler savings (_saving_frac: the CSE'd XOR
+    # reduction, bigger = fewer ops) and erasure-margin floors
+    # (_margin_min: healthy fragments above k, bigger = safer)
+    # regress downward — "_s" must not swallow throughput names like
+    # podr2_..._frags_per_s
+    if metric.endswith("_saving_frac") or metric.endswith("_margin_min"):
         return False
+    if metric.endswith("_at_risk") or metric.endswith("_lost"):
+        return True
     return metric.endswith("_ms") or (
         metric.endswith("_s") and not metric.endswith("_per_s")) or \
         metric.endswith("_per_recovered_byte")

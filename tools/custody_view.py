@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Render a durability-plane snapshot as a human-readable report.
+
+Input: a JSON file holding a ``cess_custodyStatus`` payload (the
+CustodyPlane snapshot) — fetch one with::
+
+    curl -s -d '{"jsonrpc":"2.0","id":1,
+                 "method":"cess_custodyStatus"}' \
+        127.0.0.1:9944 | jq .result > custody.json
+    python tools/custody_view.py custody.json
+    python tools/custody_view.py custody.json --timelines 8
+
+The report shows the fleet margin histogram, the at-risk / lost /
+market-divergence lists, the per-segment custody table (geometry,
+erasure margin, per-fragment holder + health), the bounded
+per-fragment lineage timelines (dispatch / transfer / verdict /
+restoral / repair events in count-sequence order — there are no
+timestamps by design) and the anomaly transition log. Stdlib only;
+read-only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict) and "result" in payload \
+            and isinstance(payload["result"], dict):
+        payload = payload["result"]
+    if not isinstance(payload, dict) or "segments" not in payload \
+            or "histogram" not in payload:
+        raise SystemExit(f"{path}: not a cess_custodyStatus payload "
+                         "(no 'segments'/'histogram' sections)")
+    return payload
+
+
+def _short(h: str, n: int = 12) -> str:
+    return h[:n] if isinstance(h, str) else str(h)
+
+
+def _render_histogram(snap: dict, out) -> None:
+    hist = snap.get("histogram", {})
+    total = sum(hist.values()) or 1
+    print(f"margin histogram ({sum(hist.values())} segment(s)):",
+          file=out)
+    for bucket in ("neg", "0", "1", "2", "3plus"):
+        n = hist.get(bucket, 0)
+        bar = "#" * int(round(40 * n / total))
+        print(f"  margin {bucket:>5}  {n:>5}  {bar}", file=out)
+
+
+def _render_risk(snap: dict, out) -> None:
+    for label, keys in (("at-risk", snap.get("at_risk", [])),
+                        ("lost", snap.get("lost", [])),
+                        ("market-divergence",
+                         snap.get("market_divergence", []))):
+        body = ", ".join(_short(k, 20) for k in keys) or "none"
+        print(f"{label} ({len(keys)}): {body}", file=out)
+
+
+def _render_segments(snap: dict, limit: int, out) -> None:
+    segments = snap.get("segments", {})
+    keys = sorted(segments, key=lambda k: (segments[k].get("margin")
+                                           is None,
+                                           segments[k].get("margin"),
+                                           k))[:limit]
+    print(f"segments (worst {len(keys)} of {len(segments)}):",
+          file=out)
+    for key in keys:
+        seg = segments[key]
+        print(f"  {_short(key, 20):<22} RS({seg.get('k')},"
+              f"{seg.get('m')}) margin={seg.get('margin')}", file=out)
+        for fr in seg.get("frags", []):
+            state = "lost" if fr.get("lost") else (
+                "ok" if fr.get("healthy") else "UNHEALTHY")
+            holder = fr.get("holder") or "(gateway)"
+            print(f"    {_short(fr.get('hash', '?')):<14} "
+                  f"holder={holder:<12} {state}", file=out)
+
+
+def _render_timelines(snap: dict, limit: int, out) -> None:
+    timelines = snap.get("timelines", {})
+    keys = sorted(timelines)[:limit]
+    print(f"fragment timelines (first {len(keys)} of "
+          f"{len(timelines)}, seq order):", file=out)
+    for fh in keys:
+        events = " -> ".join(
+            f"#{e.get('seq')}:{e.get('kind')}"
+            + (f"({e.get('miner')})" if e.get("miner") else "")
+            for e in timelines[fh]) or "(empty)"
+        print(f"  {_short(fh):<14} {events}", file=out)
+
+
+def _render_anomalies(snap: dict, out) -> None:
+    anomalies = snap.get("anomalies", {})
+    transitions = anomalies.get("transitions", [])
+    print(f"anomaly transition log ({anomalies.get('edges', 0)} "
+          f"edge(s), {len(transitions)} transition(s)):", file=out)
+    for seq, cls, key, old, to in transitions:
+        print(f"  #{seq:>4} {cls:<18} {_short(key, 20):<22} "
+              f"{old} -> {to}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a durability-plane snapshot "
+                    "(cess_custodyStatus payload) as a human-readable "
+                    "report")
+    ap.add_argument("path", help="snapshot JSON (cess_custodyStatus "
+                                 "result)")
+    ap.add_argument("--segments", type=int, default=10, metavar="N",
+                    help="worst segments shown (default 10)")
+    ap.add_argument("--timelines", type=int, default=16, metavar="N",
+                    help="fragment timelines shown (default 16)")
+    args = ap.parse_args(argv)
+    snap = _load(args.path)
+    out = sys.stdout
+    sizes = snap.get("ledger", {})
+    print(f"custody plane @ {snap.get('instance')}: "
+          f"{snap.get('rounds')} round(s), "
+          f"{sizes.get('segments')} segment(s), "
+          f"{sizes.get('fragments')} fragment(s), "
+          f"{sizes.get('events_total')} ledger event(s), "
+          f"at-risk threshold margin<={snap.get('at_risk_margin')}",
+          file=out)
+    _render_histogram(snap, out)
+    _render_risk(snap, out)
+    _render_segments(snap, args.segments, out)
+    _render_timelines(snap, args.timelines, out)
+    _render_anomalies(snap, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
